@@ -1,0 +1,26 @@
+"""gameoflifewithactors_tpu — a TPU-native cellular-automata framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+rikace/GameOfLifeWithActors (actor-per-cell Conway's Game of Life on
+Akka.NET): the per-cell actor mailbox update becomes a fused bit-packed
+stencil kernel, neighbor actor Tell messages become ``lax.ppermute`` halo
+exchange over a 2D device mesh, and the GridCoordinator/tick/renderer
+boundary survives as a host-side façade (see SURVEY.md for the capability
+contract and the provenance note — the reference mount was empty at survey
+time, so component names come from BASELINE.json's north_star).
+"""
+
+from .models.rules import (  # noqa: F401
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    RULE_REGISTRY,
+    Rule,
+    parse_rule,
+)
+from .models import seeds  # noqa: F401
+from .ops.stencil import Topology, step, multi_step  # noqa: F401
+from .ops.bitpack import pack, unpack, population  # noqa: F401
+from .ops.packed import step_packed, multi_step_packed  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,181 @@
+"""Seed patterns and grid initialisation.
+
+The reference seeds its 64×64 actor grid with a glider (BASELINE.json config
+#1); this module generalises that into a pattern library: classic still
+lifes/oscillators/spaceships as plaintext art, a standard RLE decoder, a
+Bernoulli random fill, and placement helpers. All constructors are host-side
+(NumPy) — seeding is init-time work; only the stepped grid lives on device.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ALIVE_CHARS = frozenset("XxOo*1")
+
+
+def from_plaintext(text: str) -> np.ndarray:
+    """Parse ASCII art ('X'/'O'/'*' alive, '.'/space dead) into uint8 (h, w)."""
+    lines = [ln.rstrip() for ln in text.strip("\n").splitlines()]
+    width = max(len(ln) for ln in lines)
+    grid = np.zeros((len(lines), width), dtype=np.uint8)
+    for r, ln in enumerate(lines):
+        for c, ch in enumerate(ln):
+            if ch in _ALIVE_CHARS:
+                grid[r, c] = 1
+    return grid
+
+
+_RLE_HEADER = re.compile(r"^\s*x\s*=\s*(\d+)\s*,\s*y\s*=\s*(\d+)", re.IGNORECASE)
+
+
+def from_rle(text: str) -> np.ndarray:
+    """Decode standard Game-of-Life RLE (``b``=dead, ``o``=alive, ``$``=EOL,
+    ``!``=end, ``#``-comment lines, optional ``x=,y=,rule=`` header)."""
+    width = height = None
+    body_parts = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        m = _RLE_HEADER.match(ln)
+        if m:
+            width, height = int(m.group(1)), int(m.group(2))
+            continue
+        body_parts.append(ln)
+    body = "".join(body_parts)
+    rows: list[list[int]] = [[]]
+    run = ""
+    for ch in body:
+        if ch.isdigit():
+            run += ch
+            continue
+        n = int(run) if run else 1
+        run = ""
+        if ch in ("b", "B"):
+            rows[-1].extend([0] * n)
+        elif ch in ("o", "O"):
+            rows[-1].extend([1] * n)
+        elif ch == "$":
+            for _ in range(n - 1):
+                rows.append([])
+            rows.append([])
+        elif ch == "!":
+            break
+        elif ch.isspace():
+            continue
+        else:
+            raise ValueError(f"unexpected RLE char {ch!r}")
+    w = width if width is not None else max((len(r) for r in rows), default=0)
+    h = height if height is not None else len(rows)
+    grid = np.zeros((h, w), dtype=np.uint8)
+    for r, row in enumerate(rows[:h]):
+        grid[r, : len(row)] = row[:w]
+    return grid
+
+
+def to_rle(grid: np.ndarray, rule: str = "B3/S23") -> str:
+    """Encode a uint8 grid as standard RLE (round-trips with from_rle)."""
+    h, w = grid.shape
+    out = [f"x = {w}, y = {h}, rule = {rule}"]
+    lines = []
+    for r in range(h):
+        runs = []
+        row = grid[r]
+        c = 0
+        while c < w:
+            v = row[c]
+            n = 1
+            while c + n < w and row[c + n] == v:
+                n += 1
+            runs.append((n, "o" if v else "b"))
+            c += n
+        if runs and runs[-1][1] == "b":
+            runs.pop()  # trailing dead cells are implicit
+        lines.append("".join((str(n) if n > 1 else "") + t for n, t in runs))
+    out.append("$".join(lines) + "!")
+    return "\n".join(out)
+
+
+# --- classic patterns (plaintext keeps them reviewable) ---------------------
+
+PATTERNS: Dict[str, np.ndarray] = {}
+
+
+def _register(name: str, art: str) -> None:
+    PATTERNS[name] = from_plaintext(art)
+
+
+_register("block", "XX\nXX")
+_register("blinker", "XXX")
+_register("toad", ".XXX\nXXX.")
+_register("beacon", "XX..\nXX..\n..XX\n..XX")
+_register("glider", ".X.\n..X\nXXX")
+_register("lwss", ".X..X\nX....\nX...X\nXXXX.")
+_register("r_pentomino", ".XX\nXX.\n.X.")
+_register("acorn", ".X.....\n...X...\nXX..XXX")
+_register("pulsar", """
+..XXX...XXX..
+.............
+X....X.X....X
+X....X.X....X
+X....X.X....X
+..XXX...XXX..
+.............
+..XXX...XXX..
+X....X.X....X
+X....X.X....X
+X....X.X....X
+.............
+..XXX...XXX..
+""")
+_register("gosper_gun", """
+........................X...........
+......................X.X...........
+............XX......XX............XX
+...........X...X....XX............XX
+XX........X.....X...XX..............
+XX........X...X.XX....X.X...........
+..........X.....X.......X...........
+...........X...X....................
+............XX......................
+""")
+
+
+def pattern(name: str) -> np.ndarray:
+    try:
+        return PATTERNS[name].copy()
+    except KeyError:
+        raise KeyError(f"unknown pattern {name!r}; known: {sorted(PATTERNS)}") from None
+
+
+def empty(shape: Tuple[int, int]) -> np.ndarray:
+    return np.zeros(shape, dtype=np.uint8)
+
+
+def place(grid: np.ndarray, pat: "np.ndarray | str", top: int, left: int) -> np.ndarray:
+    """Stamp a pattern onto a grid at (top, left); returns the grid."""
+    if isinstance(pat, str):
+        pat = pattern(pat)
+    ph, pw = pat.shape
+    if top < 0 or left < 0 or top + ph > grid.shape[0] or left + pw > grid.shape[1]:
+        raise ValueError(
+            f"pattern {pat.shape} at ({top},{left}) exceeds grid {grid.shape}"
+        )
+    grid[top : top + ph, left : left + pw] |= pat
+    return grid
+
+
+def seeded(shape: Tuple[int, int], pat: "np.ndarray | str", top: int = 0, left: int = 0) -> np.ndarray:
+    """A fresh grid of ``shape`` with ``pat`` stamped at (top, left)."""
+    return place(empty(shape), pat, top, left)
+
+
+def bernoulli(key: jax.Array, shape: Tuple[int, int], p: float = 0.5) -> jax.Array:
+    """Random fill: each cell alive with probability ``p`` (device-side)."""
+    return jax.random.bernoulli(key, p, shape).astype(jnp.uint8)

@@ -1,0 +1,56 @@
+"""Bit-packing between unpacked (H, W) uint8 grids and (H, W/32) uint32 words.
+
+Layout contract (shared by the SWAR step, halo exchange, and the Pallas
+kernel): bit ``i`` (LSB = bit 0) of word ``j`` in row ``r`` holds the cell at
+``(r, 32*j + i)``. Packing to 1 bit/cell cuts HBM traffic 8× vs. the
+1-byte/cell unpacked path and lets one bitwise op process 32 cells — the
+lever BASELINE.md identifies for the ≥1e9 cell-updates/s/chip target
+(uint32, not uint64, because JAX runs with x64 disabled by default and TPU
+VPU lanes are 32-bit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORD = 32  # cells per packed word
+
+_BIT_WEIGHTS = (np.uint32(1) << np.arange(WORD, dtype=np.uint32)).astype(np.uint32)
+
+
+def packed_width(width: int) -> int:
+    if width % WORD != 0:
+        raise ValueError(f"grid width {width} must be a multiple of {WORD}")
+    return width // WORD
+
+
+def pack(state: jax.Array) -> jax.Array:
+    """(H, W) uint8 in {0,1} -> (H, W/32) uint32."""
+    h, w = state.shape
+    wp = packed_width(w)
+    bits = state.reshape(h, wp, WORD).astype(jnp.uint32)
+    return jnp.sum(bits * _BIT_WEIGHTS, axis=-1, dtype=jnp.uint32)
+
+
+def unpack(packed: jax.Array) -> jax.Array:
+    """(H, W/32) uint32 -> (H, W) uint8 in {0,1}."""
+    h, wp = packed.shape
+    bits = (packed[:, :, None] >> jnp.arange(WORD, dtype=jnp.uint32)) & 1
+    return bits.astype(jnp.uint8).reshape(h, wp * WORD)
+
+
+def row_population(packed: jax.Array) -> jax.Array:
+    """Per-row live-cell counts, (H,) uint32.
+
+    Row partials stay exact in uint32 (a row of 65536 cells ≤ 2^16); the
+    grand total is summed on the host in Python ints so 65536² grids
+    (4.3e9 cells, overflowing uint32) stay exact — see :func:`population`.
+    """
+    return jnp.sum(jax.lax.population_count(packed), axis=-1, dtype=jnp.uint32)
+
+
+def population(packed: jax.Array) -> int:
+    """Exact total live-cell count (host-side Python int)."""
+    return int(np.asarray(row_population(packed)).sum(dtype=np.uint64))

@@ -1,0 +1,178 @@
+"""Bit-parallel (SWAR) Game-of-Life step on 32-cells-per-word grids.
+
+Where the reference pays ~9 mailbox messages per cell per generation
+(SURVEY.md §4b), this path pays roughly one bitwise VPU op per *word* per
+adder stage: the 8 neighbor indicator planes are summed with a carry-save
+adder network into 4 bit-planes of the neighbor count, and the B/S rule is
+evaluated as a boolean function of those planes. Everything is uint32
+bitwise ops on static shapes — XLA fuses the whole generation into a single
+elementwise pass over ~9 shifted views of the packed grid, which is
+memory-bound at ~1 bit/cell of traffic.
+
+Two entry points:
+
+- :func:`step_packed` — whole-grid step with TORUS or DEAD boundary
+  (single-device path).
+- :func:`step_packed_ext` — step on a halo-extended ``(h+2, wp+2)`` tile
+  with *no* boundary logic, for a sharded engine that builds halos via
+  ``lax.ppermute`` and calls this per tile. Keeping one core
+  plane-extraction routine for both paths is what makes a multi-device
+  bit-identity test (SURVEY.md §5) meaningful.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.rules import Rule
+from .stencil import Topology
+
+_TOP_BIT = 31  # bit index holding the highest column of a word
+
+
+def _csa(a, b, c):
+    """Carry-save full adder on bit-planes: returns (sum, carry)."""
+    s = a ^ b
+    return s ^ c, (a & b) | (c & s)
+
+
+def bit_sliced_sum(planes: Sequence[jax.Array]) -> List[jax.Array]:
+    """Sum N one-bit planes into LSB-first count bit-planes (CSA network)."""
+    level = list(planes)
+    out: List[jax.Array] = []
+    while level:
+        carries: List[jax.Array] = []
+        while len(level) >= 3:
+            s, c = _csa(level.pop(), level.pop(), level.pop())
+            level.append(s)
+            carries.append(c)
+        if len(level) == 2:
+            a, b = level.pop(), level.pop()
+            level.append(a ^ b)
+            carries.append(a & b)
+        out.append(level[0])
+        level = carries
+    return out
+
+
+def _count_eq(bits: Sequence[jax.Array], n: int) -> jax.Array:
+    """Plane that is all-ones where the bit-sliced count equals ``n``."""
+    acc = None
+    for k, b in enumerate(bits):
+        term = b if (n >> k) & 1 else ~b
+        acc = term if acc is None else acc & term
+    return acc
+
+
+def apply_rule_planes(alive: jax.Array, bits: Sequence[jax.Array], rule: Rule) -> jax.Array:
+    """Next-generation plane from the alive plane + count bit-planes."""
+    zero = jnp.zeros_like(alive)
+    born = zero
+    for n in sorted(rule.born):
+        born = born | _count_eq(bits, n)
+    keep = zero
+    for n in sorted(rule.survive):
+        keep = keep | _count_eq(bits, n)
+    return (alive & keep) | (~alive & born)
+
+
+def _shift_west(p: jax.Array, left_word: jax.Array) -> jax.Array:
+    """Plane of west neighbors: bit i <- bit i-1, borrowing bit 31 of the
+    word to the left (``left_word``) at each word boundary."""
+    return (p << 1) | (left_word >> _TOP_BIT)
+
+
+def _shift_east(p: jax.Array, right_word: jax.Array) -> jax.Array:
+    """Plane of east neighbors: bit i <- bit i+1, borrowing bit 0 of the
+    word to the right."""
+    return (p >> 1) | (right_word << _TOP_BIT)
+
+
+def _row_triplet(p: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(north, self, south) row-aligned views of the packed grid."""
+    north = jnp.roll(p, 1, axis=0)
+    south = jnp.roll(p, -1, axis=0)
+    if topology is Topology.DEAD:
+        zero_row = jnp.zeros_like(p[:1])
+        north = jnp.concatenate([zero_row, p[:-1]], axis=0)
+        south = jnp.concatenate([p[1:], zero_row], axis=0)
+    return north, p, south
+
+
+def _horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(west, center, east) planes of a row-aligned slab, with cross-word
+    carries; word columns wrap for TORUS and see zeros for DEAD."""
+    if topology is Topology.TORUS:
+        left = jnp.roll(slab, 1, axis=1)
+        right = jnp.roll(slab, -1, axis=1)
+    else:
+        zero_col = jnp.zeros_like(slab[:, :1])
+        left = jnp.concatenate([zero_col, slab[:, :-1]], axis=1)
+        right = jnp.concatenate([slab[:, 1:], zero_col], axis=1)
+    return _shift_west(slab, left), slab, _shift_east(slab, right)
+
+
+def neighbor_planes(p: jax.Array, topology: Topology) -> List[jax.Array]:
+    """The 8 Moore-neighbor indicator planes of a packed grid."""
+    planes: List[jax.Array] = []
+    for dv, slab in zip((-1, 0, 1), _row_triplet(p, topology)):
+        w, c, e = _horizontal_planes(slab, topology)
+        planes.extend([w, e] if dv == 0 else [w, c, e])
+    return planes
+
+
+@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("p",))
+def step_packed(p: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS) -> jax.Array:
+    """One generation on a (H, W/32) uint32 packed grid."""
+    bits = bit_sliced_sum(neighbor_planes(p, topology))
+    return apply_rule_planes(p, bits, rule)
+
+
+@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("p",))
+def multi_step_packed(
+    p: jax.Array,
+    n: jax.Array,
+    *,
+    rule: Rule,
+    topology: Topology = Topology.TORUS,
+) -> jax.Array:
+    """``n`` generations in one jitted fori_loop over the fused SWAR step."""
+    def body(_, s):
+        return apply_rule_planes(s, bit_sliced_sum(neighbor_planes(s, topology)), rule)
+    return jax.lax.fori_loop(0, n, body, p)
+
+
+def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
+    """(alive, 8 neighbor planes) from a halo-extended (h+2, wp+2) tile.
+
+    The extended tile carries one halo row top/bottom and one halo *word*
+    (32 columns) left/right — only 1 bit of each halo word is consumed, but
+    shipping whole words keeps ppermute payloads aligned and the plane
+    extraction uniform. No wraparound: all neighbors come from real slices.
+    """
+    h = ext.shape[0] - 2
+    planes: List[jax.Array] = []
+    center = None
+    for dv in (0, 1, 2):
+        slab = ext[dv:dv + h, :]                       # (h, wp+2)
+        left = slab[:, :-2]                            # word to the left
+        mid = slab[:, 1:-1]
+        right = slab[:, 2:]
+        w = _shift_west(mid, left)
+        e = _shift_east(mid, right)
+        if dv == 1:
+            center = mid
+            planes.extend([w, e])
+        else:
+            planes.extend([w, mid, e])
+    return center, planes
+
+
+def step_packed_ext(ext: jax.Array, rule: Rule) -> jax.Array:
+    """One generation on a halo-extended tile; returns the (h, wp) interior."""
+    alive, planes = neighbor_planes_ext(ext)
+    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)

@@ -1,0 +1,13 @@
+"""Test harness config: force an 8-fake-device CPU JAX platform.
+
+Must run before any jax import (SURVEY.md §5 — the sharding-equivalence
+tests stand in for multi-chip hardware, the standard JAX idiom). Bench and
+production paths never import this; they see the real TPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,34 @@
+"""Trivial pure-NumPy Game-of-Life oracle (SURVEY.md §5 'Oracle' row).
+
+Deliberately naive and independent of the JAX code paths: np.pad + slice
+sums + explicit per-cell rule membership. Used to cross-check the jitted
+engines on random grids.
+"""
+
+import numpy as np
+
+from gameoflifewithactors_tpu.models.rules import Rule
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def numpy_step(state: np.ndarray, rule: Rule, topology: Topology) -> np.ndarray:
+    mode = "wrap" if topology is Topology.TORUS else "constant"
+    p = np.pad(state.astype(np.int32), 1, mode=mode)
+    counts = sum(
+        p[1 + dr : p.shape[0] - 1 + dr, 1 + dc : p.shape[1] - 1 + dc]
+        for dr in (-1, 0, 1)
+        for dc in (-1, 0, 1)
+        if (dr, dc) != (0, 0)
+    )
+    out = np.zeros_like(state)
+    for n in rule.born:
+        out |= ((state == 0) & (counts == n)).astype(state.dtype)
+    for n in rule.survive:
+        out |= ((state == 1) & (counts == n)).astype(state.dtype)
+    return out
+
+
+def numpy_run(state: np.ndarray, rule: Rule, topology: Topology, n: int) -> np.ndarray:
+    for _ in range(n):
+        state = numpy_step(state, rule, topology)
+    return state

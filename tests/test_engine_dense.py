@@ -1,0 +1,85 @@
+"""Golden-sequence + oracle tests for the dense stencil (SURVEY.md §5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.models.rules import CONWAY, DAY_AND_NIGHT, HIGHLIFE
+from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step, step
+
+from .oracle import numpy_run
+
+
+def run(state, n, rule=CONWAY, topology=Topology.TORUS):
+    s = jax.numpy.asarray(state)
+    for _ in range(n):
+        s = step(s, rule=rule, topology=topology)
+    return np.asarray(s)
+
+
+def test_block_still_life():
+    g = seeds.seeded((8, 8), "block", 3, 3)
+    np.testing.assert_array_equal(run(g, 5), g)
+
+
+def test_blinker_period_2():
+    g = seeds.seeded((8, 8), "blinker", 3, 3)
+    one = run(g, 1)
+    assert not np.array_equal(one, g)
+    np.testing.assert_array_equal(run(g, 2), g)
+
+
+def test_glider_translates_1_1_every_4_gens():
+    g = seeds.seeded((16, 16), "glider", 2, 2)
+    out = run(g, 4)
+    np.testing.assert_array_equal(out, np.roll(g, (1, 1), (0, 1)))
+
+
+def test_glider_wraps_torus():
+    g = seeds.seeded((8, 8), "glider", 5, 5)
+    out = run(g, 4 * 8)  # 8 diagonal steps returns home on an 8x8 torus
+    np.testing.assert_array_equal(out, g)
+
+
+def test_glider_dies_at_dead_boundary():
+    g = seeds.seeded((8, 8), "glider", 5, 5)
+    out = run(g, 40, topology=Topology.DEAD)
+    # A glider hitting a dead-boundary corner collapses to a 2x2 block.
+    assert out.sum() == 4
+
+
+def test_gosper_gun_emits_gliders():
+    gun = seeds.pattern("gosper_gun")
+    assert gun.sum() == 36
+    g = seeds.seeded((80, 80), gun, 4, 4)
+    out = run(g, 120, topology=Topology.DEAD)
+    # Period-30 gun: after 120 gens, 4 gliders in flight (5 cells each).
+    assert out.sum() == 36 + 4 * 5
+
+
+@pytest.mark.parametrize("rule", [CONWAY, HIGHLIFE, DAY_AND_NIGHT], ids=str)
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_oracle_random_grids(rule, topology):
+    rng = np.random.default_rng(42)
+    g = rng.integers(0, 2, size=(33, 47), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        run(g, 5, rule=rule, topology=topology),
+        numpy_run(g, rule, topology, 5),
+    )
+
+
+def test_multi_step_matches_repeated_step():
+    rng = np.random.default_rng(7)
+    g = rng.integers(0, 2, size=(32, 32), dtype=np.uint8)
+    got = np.asarray(multi_step(jax.numpy.asarray(g), 7, rule=CONWAY))
+    np.testing.assert_array_equal(got, run(g, 7))
+
+
+def test_multi_step_dynamic_n_no_recompile():
+    g = jax.numpy.zeros((16, 16), dtype=jax.numpy.uint8)
+    # n must stay a traced scalar operand (not a static arg), so different
+    # generation counts share one executable.
+    avals = multi_step.lower(g, 3, rule=CONWAY).in_avals
+    assert any(a.shape == () and "int" in a.dtype.name for a in jax.tree.leaves(avals))
+    multi_step(g, 5, rule=CONWAY)  # different n: must not need a new lowering

@@ -1,0 +1,62 @@
+"""Rule parsing + exhaustive rule-table correctness (SURVEY.md §5 'Unit')."""
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models.rules import (
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    Rule,
+    parse_rule,
+)
+from gameoflifewithactors_tpu.ops.stencil import apply_rule
+
+
+def test_parse_bs_notation():
+    r = parse_rule("B3/S23")
+    assert r.born == frozenset({3}) and r.survive == frozenset({2, 3})
+    assert parse_rule("b36/s23") == Rule(frozenset({3, 6}), frozenset({2, 3}), "HighLife")
+
+
+def test_parse_classic_sb_notation():
+    r = parse_rule("23/3")  # classic survival/birth order
+    assert r.born == frozenset({3}) and r.survive == frozenset({2, 3})
+
+
+def test_parse_named():
+    assert parse_rule("conway") == CONWAY
+    assert parse_rule("HighLife") == HIGHLIFE
+    assert parse_rule("Day & Night") == DAY_AND_NIGHT
+    assert parse_rule(CONWAY) is CONWAY
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rule("B9/S23")
+    with pytest.raises(ValueError):
+        parse_rule("nonsense")
+
+
+def test_notation_roundtrip():
+    for r in (CONWAY, HIGHLIFE, DAY_AND_NIGHT):
+        assert parse_rule(r.notation) == r
+
+
+def test_masks():
+    assert CONWAY.birth_mask == 0b000001000
+    assert CONWAY.survive_mask == 0b000001100
+
+
+@pytest.mark.parametrize("rule", [CONWAY, HIGHLIFE, DAY_AND_NIGHT], ids=str)
+def test_rule_table_exhaustive(rule):
+    """All 2 states x 9 counts, vectorized apply_rule vs scalar oracle."""
+    states = np.repeat(np.arange(2, dtype=np.uint8), 9).reshape(2, 9)
+    counts = np.tile(np.arange(9, dtype=np.uint8), 2).reshape(2, 9)
+    got = np.asarray(apply_rule(states, counts, rule))
+    want = np.array(
+        [[rule.next_state(int(s), int(c)) for s, c in zip(srow, crow)]
+         for srow, crow in zip(states, counts)],
+        dtype=np.uint8,
+    )
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,48 @@
+"""Seed library: plaintext/RLE parsing, placement, Bernoulli fill."""
+
+import jax
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models import seeds
+
+
+def test_from_plaintext():
+    g = seeds.from_plaintext(".X.\n..X\nXXX")
+    np.testing.assert_array_equal(g, [[0, 1, 0], [0, 0, 1], [1, 1, 1]])
+
+
+def test_rle_decode_glider():
+    g = seeds.from_rle("x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!")
+    np.testing.assert_array_equal(g, seeds.pattern("glider"))
+
+
+def test_rle_decode_multiline_and_blank_rows():
+    # 2$ skips a full row; runs of b at line end are implicit.
+    g = seeds.from_rle("x = 2, y = 3\noo2$oo!")
+    np.testing.assert_array_equal(g, [[1, 1], [0, 0], [1, 1]])
+
+
+def test_rle_roundtrip():
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 2, size=(9, 14), dtype=np.uint8)
+    np.testing.assert_array_equal(seeds.from_rle(seeds.to_rle(g)), g)
+
+
+def test_place_bounds_check():
+    with pytest.raises(ValueError):
+        seeds.seeded((4, 4), "gosper_gun")
+
+
+def test_patterns_registry():
+    for name in ("block", "blinker", "glider", "gosper_gun", "pulsar"):
+        assert seeds.pattern(name).sum() > 0
+    with pytest.raises(KeyError):
+        seeds.pattern("nope")
+
+
+def test_bernoulli_fill():
+    g = seeds.bernoulli(jax.random.key(0), (256, 256), p=0.5)
+    frac = float(np.asarray(g).mean())
+    assert 0.45 < frac < 0.55
+    assert g.dtype == jax.numpy.uint8
