@@ -43,14 +43,24 @@ def _pad_mode(topology: Topology) -> dict:
 def neighbor_counts(state: jax.Array, topology: Topology) -> jax.Array:
     """Count live Moore neighbors (excluding self) for every cell.
 
-    Uses the separable row-sum trick: 3-row sums then 3-column sums
-    (6 adds over the array instead of 8 independent shifts), which XLA
-    fuses into one pass. ``state`` is (H, W) uint8 in {0, 1}.
+    ``state`` is (H, W) uint8 in {0, 1}. Implemented as boundary
+    materialisation (pad) + the halo-extended kernel, so the single-device
+    and sharded paths share one copy of the stencil math.
     """
-    p = jnp.pad(state, 1, **_pad_mode(topology))
-    rows = p[:-2, :] + p[1:-1, :] + p[2:, :]            # (H, W+2)
-    win = rows[:, :-2] + rows[:, 1:-1] + rows[:, 2:]    # (H, W): 3x3 incl. self
-    return win - state
+    return neighbor_counts_ext(jnp.pad(state, 1, **_pad_mode(topology)))
+
+
+def neighbor_counts_ext(ext: jax.Array) -> jax.Array:
+    """Neighbor counts for the interior of a halo-extended (h+2, w+2) tile.
+
+    No padding/wrap logic: halos were materialised by the caller (jnp.pad
+    above, or the sharded engine's ppermute exchange). Uses the separable
+    row-sum trick — 3-row sums then 3-column sums (6 adds instead of 8
+    shifted adds), which XLA fuses into one pass. Returns (h, w) counts.
+    """
+    rows = ext[:-2, :] + ext[1:-1, :] + ext[2:, :]
+    win = rows[:, :-2] + rows[:, 1:-1] + rows[:, 2:]
+    return win - ext[1:-1, 1:-1]
 
 
 def apply_rule(state: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:

@@ -1,0 +1,72 @@
+"""Two-phase corner-correct halo exchange via ``lax.ppermute``.
+
+This is the TPU-native replacement for the reference's neighbor-to-neighbor
+actor ``Tell`` messages (BASELINE.json north_star: "lax.ppermute halo
+exchange replacing neighbor-to-neighbor actor Tell messages"). Where each
+CellActor Tells its state to 8 neighbors every generation (~8·N·M mailbox
+messages), a sharded tile sends 4 ppermute messages per generation — two
+1-row strips and two 1-column strips riding ICI — and the 8-way neighbor
+data dependency is reconstructed locally by the stencil.
+
+Corner correctness (SURVEY.md §8 "hard parts") comes from phasing: rows are
+exchanged first, then *columns of the row-extended tile*, so the column
+strips already carry the north/south halo rows — my NW corner halo is the
+bottom-right element of my NW diagonal neighbor, delivered via my west
+neighbor's extended edge. No diagonal sends needed.
+
+Boundary semantics: for TORUS the permutation wraps; for DEAD the edge
+tiles receive ``lax.ppermute``'s zero-fill for absent sources, which is
+exactly the all-dead boundary — no special-casing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.stencil import Topology
+from .mesh import COL_AXIS, ROW_AXIS
+
+
+def _shift_perm(n: int, direction: int, wrap: bool) -> List[Tuple[int, int]]:
+    """(source, dest) pairs sending data ``direction`` steps along an axis:
+    direction=+1 means device i's data lands on device i+1."""
+    perm = [(i, i + direction) for i in range(n) if 0 <= i + direction < n]
+    if wrap:
+        if direction == +1:
+            perm.append((n - 1, 0))
+        else:
+            perm.append((0, n - 1))
+    return perm
+
+
+def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis: str = ROW_AXIS) -> jax.Array:
+    """(h, w) tile -> (h+2, w) with north/south halo rows from mesh neighbors."""
+    wrap = topology is Topology.TORUS
+    # My north halo row is my north neighbor's bottom row: data flows +1.
+    north = lax.ppermute(tile[-1:], axis, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(tile[:1], axis, _shift_perm(nx, -1, wrap))
+    return jnp.concatenate([north, tile, south], axis=0)
+
+
+def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_AXIS) -> jax.Array:
+    """(h+2, w) row-extended tile -> (h+2, w+2) with west/east halo columns
+    (including the diagonal corners carried in the extended rows)."""
+    wrap = topology is Topology.TORUS
+    west = lax.ppermute(ext[:, -1:], axis, _shift_perm(ny, +1, wrap))
+    east = lax.ppermute(ext[:, :1], axis, _shift_perm(ny, -1, wrap))
+    return jnp.concatenate([west, ext, east], axis=1)
+
+
+def exchange_halo(tile: jax.Array, nx: int, ny: int, topology: Topology) -> jax.Array:
+    """Full two-phase exchange: (h, w) tile -> (h+2, w+2) haloed tile.
+
+    Works identically for unpacked (halo = 1 cell strip) and packed tiles
+    (halo = 1 word strip, of which the stencil consumes 1 bit — shipping
+    whole words keeps payloads aligned; at 32768 rows/tile the E/W halo is
+    128 KB, negligible on ICI).
+    """
+    return exchange_cols(exchange_rows(tile, nx, topology), ny, topology)

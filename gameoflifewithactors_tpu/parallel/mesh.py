@@ -1,0 +1,69 @@
+"""Device-mesh construction for 2D grid sharding.
+
+The reference "scales" by spawning more actors on one CPU (SURVEY.md §2 —
+its entire communication substrate is the in-process Akka mailbox). The
+TPU-native scaling story is a 2D ``jax.sharding.Mesh``: the grid is cut into
+(nx, ny) tiles, one per device, and neighbor state crosses tile edges as
+``ppermute`` halo exchange over ICI (see halo.py). These helpers build
+near-square meshes from whatever devices exist — real TPU slices or the
+8-fake-CPU-device test rig.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "x"  # shards grid rows
+COL_AXIS = "y"  # shards grid columns (packed: word columns)
+
+
+def factor2d(n: int) -> Tuple[int, int]:
+    """Factor n devices into the most-square (nx, ny) grid, nx <= ny.
+
+    Near-square tiles minimise halo perimeter per tile (the analogue of
+    picking a good actor-partitioning, except here it is bytes on ICI).
+    """
+    best = (1, n)
+    for a in range(1, int(np.sqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return best
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 2D mesh with axes (ROW_AXIS, COL_AXIS) over the given devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = factor2d(len(devices))
+    nx, ny = shape
+    if nx * ny != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {nx * ny} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(nx, ny), (ROW_AXIS, COL_AXIS))
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that tiles a (H, W) or (H, W/32) grid 2D over the mesh."""
+    return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+
+def check_divisible(shape: Tuple[int, int], mesh: Mesh) -> None:
+    h, w = shape
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if h % nx or w % ny:
+        raise ValueError(
+            f"grid {shape} not divisible by mesh ({nx}, {ny}); "
+            f"pad the grid or pick a different mesh shape"
+        )
+
+
+def device_put_sharded_grid(grid: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a (possibly packed) grid onto the mesh with 2D tiling."""
+    check_divisible(grid.shape, mesh)
+    return jax.device_put(grid, grid_sharding(mesh))

@@ -1,7 +1,9 @@
 """Test harness config: force an 8-fake-device CPU JAX platform.
 
-Must run before any jax import (SURVEY.md §5 — the sharding-equivalence
-tests stand in for multi-chip hardware, the standard JAX idiom). Bench and
+The sharding-equivalence tests stand in for multi-chip hardware
+(SURVEY.md §5), the standard JAX idiom. Note the tunneled TPU plugin in
+this image ignores the JAX_PLATFORMS *env var*, so we must also set the
+``jax_platforms`` config before the first backend query. Bench and
 production paths never import this; they see the real TPU.
 """
 
@@ -11,3 +13,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (env must be staged first)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,113 @@
+"""Sharding-equivalence tests: the multi-node story without a cluster.
+
+Runs the sharded engine on 8 fake CPU devices (conftest.py) and asserts
+bit-identity with the single-device engine (SURVEY.md §5): halo-exchange
+bugs show up as edge-row/corner divergence, making this suite the "race
+detector" for the communication layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.models.rules import CONWAY, DAY_AND_NIGHT, HIGHLIFE
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+from gameoflifewithactors_tpu.parallel import sharded
+
+
+def _mesh(shape):
+    n = shape[0] * shape[1]
+    return mesh_lib.make_mesh(shape, jax.devices()[:n])
+
+
+def test_eight_fake_devices_present():
+    assert len(jax.devices()) == 8, "conftest must provide 8 fake CPU devices"
+
+
+def test_factor2d():
+    assert mesh_lib.factor2d(8) == (2, 4)
+    assert mesh_lib.factor2d(4) == (2, 2)
+    assert mesh_lib.factor2d(7) == (1, 7)
+    assert mesh_lib.factor2d(64) == (8, 8)
+
+
+def test_mesh_shape_validation():
+    with pytest.raises(ValueError):
+        mesh_lib.make_mesh((3, 3), jax.devices()[:8])
+    with pytest.raises(ValueError):
+        mesh_lib.check_divisible((30, 64), _mesh((4, 2)))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (8, 1), (1, 8), (2, 2)])
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_packed_sharded_bit_identity(mesh_shape, topology):
+    """Random soup, 8 generations: sharded == single-device, bit for bit."""
+    rng = np.random.default_rng(123)
+    g = rng.integers(0, 2, size=(32, 256), dtype=np.uint8)
+    p_single = bitpack.pack(jnp.asarray(g))
+    want = np.asarray(bitpack.unpack(multi_step_packed(p_single, 8, rule=CONWAY, topology=topology)))
+
+    m = _mesh(mesh_shape)
+    p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+    run = sharded.make_multi_step_packed(m, CONWAY, topology)
+    got = np.asarray(bitpack.unpack(run(p, 8)))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("rule", [HIGHLIFE, DAY_AND_NIGHT], ids=str)
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_dense_sharded_matches_single(rule, topology):
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 2, size=(64, 64), dtype=np.uint8)
+    want = np.asarray(multi_step(jnp.asarray(g), 6, rule=rule, topology=topology))
+
+    m = _mesh((2, 4))
+    x = mesh_lib.device_put_sharded_grid(jnp.asarray(g), m)
+    run = sharded.make_multi_step_dense(m, rule, topology)
+    np.testing.assert_array_equal(np.asarray(run(x, 6)), want)
+
+
+def test_glider_crosses_tile_corner():
+    """A glider flying SE through the interior 4-corner point of a (2, 2)
+    mesh exercises the diagonal (corner) halo path — the classic bug."""
+    m = _mesh((2, 2))
+    g = seeds.seeded((64, 64), "glider", 28, 28)  # just NW of the center
+    want_dense = np.asarray(multi_step(jnp.asarray(g), 24, rule=CONWAY))
+
+    p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+    run = sharded.make_multi_step_packed(m, CONWAY, Topology.TORUS)
+    got = np.asarray(bitpack.unpack(run(p, 24)))
+    np.testing.assert_array_equal(got, want_dense)
+    assert got.sum() == 5  # still a glider
+
+
+def test_glider_wraps_global_torus_across_shards():
+    """Torus wrap must cross the *global* boundary, not each tile's."""
+    m = _mesh((2, 4))
+    g = seeds.seeded((32, 128), "glider", 28, 124)  # at the SE global corner
+    want = np.asarray(multi_step(jnp.asarray(g), 64, rule=CONWAY))
+    p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+    run = sharded.make_multi_step_packed(m, CONWAY, Topology.TORUS)
+    np.testing.assert_array_equal(np.asarray(bitpack.unpack(run(p, 64))), want)
+
+
+def test_single_step_builder():
+    m = _mesh((2, 4))
+    g = seeds.seeded((16, 256), "blinker", 8, 100)
+    p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+    step = sharded.make_step_packed(m, CONWAY, Topology.TORUS)
+    two = step(step(p))
+    np.testing.assert_array_equal(np.asarray(bitpack.unpack(two)), g)
+
+
+def test_output_stays_sharded():
+    """The stepped grid must keep its 2D sharding (no implicit gather)."""
+    m = _mesh((2, 4))
+    p = mesh_lib.device_put_sharded_grid(jnp.zeros((32, 8), jnp.uint32), m)
+    out = sharded.make_step_packed(m, CONWAY, Topology.TORUS)(p)
+    assert out.sharding == mesh_lib.grid_sharding(m)
