@@ -22,5 +22,9 @@ from .models import seeds  # noqa: F401
 from .ops.stencil import Topology, step, multi_step  # noqa: F401
 from .ops.bitpack import pack, unpack, population  # noqa: F401
 from .ops.packed import step_packed, multi_step_packed  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .coordinator import GridCoordinator, RenderFrame  # noqa: F401
+from .scheduler import TickScheduler  # noqa: F401
+from .config import SimulationConfig  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,47 @@
+"""Command-line entry point — the reference's ``Program`` role.
+
+``python -m gameoflifewithactors_tpu --grid 1024x1024 --seed random --steps
+1000 --metrics jsonl`` runs the full stack: config → coordinator → tick
+scheduler → renderer/metrics → optional checkpoint, mirroring the
+reference's Program.main → ActorSystem → GridCoordinator startup
+(SURVEY.md §4a) as one construction path.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+from .config import from_args
+from .utils.render import ConsoleRenderer
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    cfg, args = from_args(argv)
+    coordinator, scheduler = cfg.build()
+
+    if args.render == "live":
+        coordinator.subscribe(ConsoleRenderer())
+    # Pacing (rate limit / periodic metrics / live frames) needs the tick
+    # loop; otherwise the whole run is one device dispatch.
+    needs_pacing = args.render == "live" or cfg.rate_hz or cfg.metrics
+    if needs_pacing:
+        scheduler.run(max_generations=cfg.steps)
+    else:
+        coordinator.run(cfg.steps)
+
+    if args.render == "final":
+        ConsoleRenderer(ansi=False)(coordinator.current_frame())
+
+    if cfg.checkpoint:
+        from .utils import checkpoint as ckpt_lib
+
+        path = ckpt_lib.save(coordinator.engine, cfg.checkpoint)
+        print(f"checkpoint written: {path}", file=sys.stderr)
+
+    coordinator.engine.block_until_ready()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

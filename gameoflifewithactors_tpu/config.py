@@ -1,0 +1,184 @@
+"""Simulation configuration + CLI parsing (SURVEY.md §6 'Config/flag system').
+
+The reference hardcodes grid size and seed in ``Program`` [RECON]; here
+every knob the framework has is a dataclass field with a CLI flag, and the
+rule string parser is a first-class feature (any "B…/S…" rule, plus named
+rules). ``SimulationConfig.build()`` assembles the whole stack —
+coordinator, mesh, renderer, metrics — so the CLI and library users share
+one construction path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import re
+import sys
+from typing import Optional, Tuple
+
+from .ops.stencil import Topology
+
+
+@dataclasses.dataclass
+class SimulationConfig:
+    height: int = 64
+    width: int = 64
+    rule: str = "B3/S23"
+    topology: str = "torus"                 # torus | dead
+    seed: Optional[str] = "glider"          # pattern name, @file.rle, or None
+    random_fill: Optional[float] = None     # Bernoulli p (overrides seed)
+    seed_origin: Optional[Tuple[int, int]] = None
+    rng_seed: int = 0
+    backend: str = "packed"                 # packed | dense
+    mesh: Optional[str] = None              # None | "auto" | "2x4"
+    steps: int = 100
+    render_every: int = 1
+    view_height: int = 40
+    view_width: int = 80
+    rate_hz: Optional[float] = None
+    metrics: Optional[str] = None           # "jsonl" | "csv:PATH" | None
+    track_population: bool = False
+    checkpoint: Optional[str] = None        # save path (written at end)
+    resume: Optional[str] = None            # checkpoint to resume from
+
+    # -- assembly ------------------------------------------------------------
+
+    def build_mesh(self):
+        from .parallel import mesh as mesh_lib
+
+        if self.mesh is None:
+            return None
+        if self.mesh == "auto":
+            return mesh_lib.make_mesh()
+        m = re.fullmatch(r"(\d+)x(\d+)", self.mesh)
+        if not m:
+            raise ValueError(f"--mesh must be 'auto' or like '2x4', got {self.mesh!r}")
+        return mesh_lib.make_mesh((int(m.group(1)), int(m.group(2))))
+
+    def build_metrics(self):
+        from .utils import metrics as metrics_lib
+
+        if self.metrics is None:
+            return None
+        if self.metrics == "jsonl":
+            return metrics_lib.MetricsLogger(metrics_lib.JsonlSink(sys.stderr))
+        if self.metrics.startswith("csv:"):
+            f = open(self.metrics[4:], "w", newline="")
+            return metrics_lib.MetricsLogger(metrics_lib.CsvSink(f))
+        raise ValueError(f"--metrics must be 'jsonl' or 'csv:PATH', got {self.metrics!r}")
+
+    def build(self):
+        """Construct the full (coordinator, scheduler) stack."""
+        from .coordinator import GridCoordinator
+        from .models import seeds as seeds_lib
+        from .scheduler import TickScheduler
+        from .utils import checkpoint as ckpt_lib
+
+        topology = Topology(self.topology)
+        mesh = self.build_mesh()
+        if self.resume:
+            engine = ckpt_lib.load_engine(self.resume, mesh=mesh, backend=self.backend)
+            coordinator = GridCoordinator.from_engine(
+                engine,
+                track_population=self.track_population,
+                metrics=self.build_metrics(),
+                view_shape=(self.view_height, self.view_width),
+            )
+        else:
+            # random_fill overrides the default seed (only an *explicitly*
+            # conflicting combination should error, and GridCoordinator
+            # can't tell a default 'glider' from a requested one)
+            seed = None if self.random_fill is not None else self.seed
+            if isinstance(seed, str) and seed.startswith("@"):
+                seed = seeds_lib.from_rle(open(seed[1:]).read())
+            coordinator = GridCoordinator(
+                (self.height, self.width),
+                self.rule,
+                seed=seed,
+                seed_origin=self.seed_origin,
+                random_fill=self.random_fill,
+                rng_seed=self.rng_seed,
+                topology=topology,
+                mesh=mesh,
+                backend=self.backend,
+                track_population=self.track_population,
+                metrics=self.build_metrics(),
+                view_shape=(self.view_height, self.view_width),
+            )
+        scheduler = TickScheduler(
+            coordinator,
+            rate_hz=self.rate_hz,
+            generations_per_tick=max(1, self.render_every),
+        )
+        return coordinator, scheduler
+
+
+def _parse_geometry(text: str) -> Tuple[int, int]:
+    m = re.fullmatch(r"(\d+)x(\d+)", text)
+    if not m:
+        raise argparse.ArgumentTypeError(f"expected HxW like '1024x1024', got {text!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gameoflifewithactors_tpu",
+        description="TPU-native Game of Life (capabilities of rikace/GameOfLifeWithActors)",
+    )
+    p.add_argument("--grid", type=_parse_geometry, default=(64, 64), metavar="HxW",
+                   help="grid size, e.g. 1024x1024 (default 64x64, the reference's size)")
+    p.add_argument("--rule", default="B3/S23",
+                   help="B/S rule string or name (conway, highlife, 'day & night', ...)")
+    p.add_argument("--topology", choices=[t.value for t in Topology], default="torus")
+    p.add_argument("--seed", default="glider",
+                   help="pattern name, @file.rle, 'random', or 'empty'")
+    p.add_argument("--random-p", type=float, default=0.5, help="fill prob for --seed random")
+    p.add_argument("--seed-at", type=_parse_geometry, default=None, metavar="RxC",
+                   help="pattern top-left placement (default: centered)")
+    p.add_argument("--rng-seed", type=int, default=0)
+    p.add_argument("--backend", choices=["packed", "dense"], default="packed")
+    p.add_argument("--mesh", default=None,
+                   help="'auto' (all devices) or 'NXxNY'; default single-device")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--render", choices=["off", "live", "final"], default="off")
+    p.add_argument("--render-every", type=int, default=1, metavar="N",
+                   help="draw every N generations in live mode")
+    p.add_argument("--view", type=_parse_geometry, default=(40, 80), metavar="HxW",
+                   help="max console view size (grid is downsampled to fit)")
+    p.add_argument("--rate", type=float, default=None, metavar="HZ",
+                   help="tick rate limit; default unthrottled")
+    p.add_argument("--metrics", default=None, help="'jsonl' (stderr) or 'csv:PATH'")
+    p.add_argument("--population", action="store_true", help="track live-cell count")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="write final state here")
+    p.add_argument("--resume", default=None, metavar="PATH",
+                   help="resume from a checkpoint (the checkpoint's grid/rule/"
+                        "seed/topology win; --grid/--rule/--seed/--topology are ignored)")
+    return p
+
+
+def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
+    args = make_parser().parse_args(argv)
+    (h, w) = args.grid
+    cfg = SimulationConfig(
+        height=h,
+        width=w,
+        rule=args.rule,
+        topology=args.topology,
+        seed=None if args.seed in ("random", "empty") else args.seed,
+        random_fill=args.random_p if args.seed == "random" else None,
+        seed_origin=args.seed_at,
+        rng_seed=args.rng_seed,
+        backend=args.backend,
+        mesh=args.mesh,
+        steps=args.steps,
+        render_every=args.render_every,
+        view_height=args.view[0],
+        view_width=args.view[1],
+        rate_hz=args.rate,
+        metrics=args.metrics,
+        track_population=args.population,
+        checkpoint=args.checkpoint,
+        resume=args.resume,
+    )
+    return cfg, args

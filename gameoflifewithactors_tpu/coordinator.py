@@ -1,0 +1,195 @@
+"""GridCoordinator — the actor-shaped façade over the stencil engine.
+
+The reference's ``GridCoordinator`` actor spawns an N×M grid of
+``CellActor``s, wires each to its 8 Moore neighbors, broadcasts Tick,
+barriers on N·M replies, and hands each finished generation to a renderer
+(BASELINE.json north_star; SURVEY.md §2/§4 — reference mount empty, names
+from driver metadata). This class preserves that *surface* — construct,
+tick, run, snapshot, subscribe — while deleting the machinery:
+
+- spawn/wire  → array allocation (the neighbor graph is implicit in the
+  stencil's index arithmetic);
+- Tick broadcast + reply barrier → one fused jit step (SPMD dataflow *is*
+  the barrier);
+- per-cell mailbox update → one VPU lane of the bit-packed kernel. A
+  ``CellActor`` survives as this documented equivalence, not as an object:
+  cell (r, c)'s "mailbox" is bit (32·j+i) of word (r, j); its "receive" is
+  the carry-save neighbor sum; its "Tell" is the halo/shift data movement.
+
+Subscribers play the reference's Renderer role: callables invoked after
+each tick (or every ``render_every`` ticks) with a RenderFrame.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .engine import Engine
+from .models import seeds as seeds_lib
+from .models.rules import Rule, parse_rule
+from .ops.stencil import Topology
+from .utils.metrics import MetricsLogger, StepMetrics
+
+
+@dataclasses.dataclass(frozen=True)
+class RenderFrame:
+    """What a subscriber sees after a tick — the analogue of the grid the
+    reference's coordinator hands its Renderer each generation."""
+
+    grid: np.ndarray            # possibly downsampled uint8 view
+    generation: int
+    population: Optional[int]   # None unless track_population is on
+    full_shape: Tuple[int, int]
+
+
+Subscriber = Callable[[RenderFrame], None]
+
+
+class GridCoordinator:
+    """Facade: construct(grid, rule, seed) / tick() / run(n) / snapshot()."""
+
+    def __init__(
+        self,
+        shape: Tuple[int, int],
+        rule: "Rule | str" = "B3/S23",
+        *,
+        seed: "str | np.ndarray | None" = None,
+        seed_origin: Optional[Tuple[int, int]] = None,
+        random_fill: Optional[float] = None,
+        rng_seed: int = 0,
+        topology: Topology = Topology.TORUS,
+        mesh: Optional[Mesh] = None,
+        backend: str = "packed",
+        track_population: bool = False,
+        metrics: Optional[MetricsLogger] = None,
+        view_shape: Optional[Tuple[int, int]] = None,
+    ):
+        grid = self._build_seed(shape, seed, seed_origin, random_fill, rng_seed)
+        engine = Engine(grid, rule, topology=topology, mesh=mesh, backend=backend)
+        self._init_from_engine(engine, track_population, metrics, view_shape)
+
+    def _init_from_engine(self, engine, track_population, metrics, view_shape) -> None:
+        self.engine = engine
+        self.track_population = track_population
+        self.metrics = metrics
+        self.view_shape = view_shape
+        self._subscribers: List[Subscriber] = []
+
+    @classmethod
+    def from_engine(
+        cls,
+        engine: Engine,
+        *,
+        track_population: bool = False,
+        metrics: Optional[MetricsLogger] = None,
+        view_shape: Optional[Tuple[int, int]] = None,
+    ) -> "GridCoordinator":
+        """Wrap an existing Engine (e.g. one rebuilt from a checkpoint)."""
+        self = cls.__new__(cls)
+        self._init_from_engine(engine, track_population, metrics, view_shape)
+        return self
+
+    @staticmethod
+    def _build_seed(shape, seed, seed_origin, random_fill, rng_seed) -> np.ndarray:
+        import jax
+
+        if random_fill is not None:
+            if seed is not None:
+                raise ValueError("give either `seed` or `random_fill`, not both")
+            return np.asarray(
+                seeds_lib.bernoulli(jax.random.key(rng_seed), shape, random_fill)
+            )
+        if seed is None:
+            return seeds_lib.empty(shape)
+        if isinstance(seed, str):
+            pat = seeds_lib.pattern(seed)
+        else:
+            pat = np.asarray(seed, dtype=np.uint8)
+        if seed_origin is None:
+            # center the pattern, like dropping a glider into the middle
+            seed_origin = (
+                (shape[0] - pat.shape[0]) // 2,
+                (shape[1] - pat.shape[1]) // 2,
+            )
+        return seeds_lib.seeded(shape, pat, *seed_origin)
+
+    # -- reference surface ---------------------------------------------------
+
+    @property
+    def rule(self) -> Rule:
+        return self.engine.rule
+
+    @property
+    def generation(self) -> int:
+        return self.engine.generation
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.engine.shape
+
+    def subscribe(self, fn: Subscriber) -> Callable[[], None]:
+        """Register a per-tick observer; returns an unsubscribe handle."""
+        self._subscribers.append(fn)
+        return lambda: self._subscribers.remove(fn)
+
+    def tick(self, n: int = 1) -> None:
+        """Advance n generations and notify subscribers once (the reference
+        notifies its renderer per generation; batching is the knob that
+        keeps readback off the device hot loop)."""
+        t0 = time.perf_counter()
+        self.engine.step(n)
+        if self.metrics is not None:
+            self.engine.block_until_ready()
+            dt = time.perf_counter() - t0
+            cells = self.shape[0] * self.shape[1] * n
+            self.metrics.log(
+                StepMetrics(
+                    generation=self.generation,
+                    generations_stepped=n,
+                    wall_seconds=dt,
+                    cell_updates_per_sec=cells / dt if dt > 0 else float("inf"),
+                    population=self.population() if self.track_population else None,
+                )
+            )
+        self._notify()
+
+    def run(self, generations: int, *, render_every: int = 0) -> None:
+        """Run ``generations`` generations; if render_every > 0, surface a
+        frame to subscribers every that many generations."""
+        if render_every and render_every > 0:
+            done = 0
+            while done < generations:
+                chunk = min(render_every, generations - done)
+                self.tick(chunk)
+                done += chunk
+        else:
+            self.tick(generations)
+
+    def snapshot(self) -> np.ndarray:
+        return self.engine.snapshot()
+
+    def population(self) -> int:
+        return self.engine.population()
+
+    # -- internals -----------------------------------------------------------
+
+    def current_frame(self) -> RenderFrame:
+        """The frame a subscriber would see right now (downsampled view)."""
+        return RenderFrame(
+            grid=self.engine.snapshot(max_shape=self.view_shape),
+            generation=self.generation,
+            population=self.population() if self.track_population else None,
+            full_shape=self.shape,
+        )
+
+    def _notify(self) -> None:
+        if not self._subscribers:
+            return
+        frame = self.current_frame()
+        for fn in list(self._subscribers):
+            fn(frame)

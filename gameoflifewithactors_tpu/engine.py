@@ -1,0 +1,160 @@
+"""Device-side simulation engine: owns the grid buffer and steps it.
+
+The engine is the layer the reference does not have as a separate thing —
+there, grid state lives scattered across N·M actor mailboxes and a
+generation is ~9·N·M messages (SURVEY.md §4b). Here state is one device
+array (bit-packed by default) stepped by fused XLA kernels, optionally
+sharded 2D over a mesh. Everything host-facing (rendering, scheduling,
+checkpointing) talks to the engine through :meth:`snapshot`/:meth:`step`,
+keeping device round-trips off the hot loop: ``step`` only *dispatches*
+work (JAX async dispatch pipelines generations); data comes back only when
+snapshot/population are explicitly asked for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .models.rules import Rule, parse_rule
+from .ops import bitpack
+from .ops.packed import multi_step_packed
+from .ops.stencil import Topology, multi_step
+from .parallel import mesh as mesh_lib
+from .parallel import sharded
+
+BACKENDS = ("packed", "dense")
+
+
+class Engine:
+    """Steps a Game-of-Life grid on device.
+
+    Parameters
+    ----------
+    grid: (H, W) uint8 array-like in {0, 1} — the initial universe.
+    rule: a Rule or rule string ("B3/S23", "highlife", ...).
+    topology: TORUS (wrap) or DEAD (all-dead boundary).
+    mesh: optional jax Mesh for 2D sharding; None = single device.
+    backend: "packed" (32 cells/word SWAR, the fast path) or "dense"
+        (1 byte/cell, debug path).
+    """
+
+    def __init__(
+        self,
+        grid,
+        rule: "Rule | str",
+        *,
+        topology: Topology = Topology.TORUS,
+        mesh: Optional[Mesh] = None,
+        backend: str = "packed",
+    ):
+        if backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
+        self.rule = parse_rule(rule)
+        self.topology = topology
+        self.mesh = mesh
+        self.backend = backend
+        grid = jnp.asarray(np.asarray(grid, dtype=np.uint8))
+        if grid.ndim != 2:
+            raise ValueError(f"grid must be 2D, got shape {grid.shape}")
+        self.shape: Tuple[int, int] = tuple(grid.shape)
+        self.generation = 0
+
+        state = bitpack.pack(grid) if backend == "packed" else grid
+        if mesh is not None:
+            state = mesh_lib.device_put_sharded_grid(state, mesh)
+            make = (
+                sharded.make_multi_step_packed
+                if backend == "packed"
+                else sharded.make_multi_step_dense
+            )
+            self._run = make(mesh, self.rule, topology)
+        else:
+            if backend == "packed":
+                self._run = lambda s, n: multi_step_packed(
+                    s, n, rule=self.rule, topology=self.topology
+                )
+            else:
+                self._run = lambda s, n: multi_step(
+                    s, n, rule=self.rule, topology=self.topology
+                )
+        self._state = state
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, n: int = 1) -> None:
+        """Advance ``n`` generations (dispatches async; does not block)."""
+        if n < 0:
+            raise ValueError(f"cannot step a negative number of generations: {n}")
+        if n == 0:
+            return
+        self._state = self._run(self._state, n)
+        self.generation += n
+
+    def block_until_ready(self) -> None:
+        self._state.block_until_ready()
+
+    # -- observation ---------------------------------------------------------
+
+    @property
+    def state(self) -> jax.Array:
+        """The raw device array (packed words or uint8 cells)."""
+        return self._state
+
+    def snapshot(self, max_shape: Optional[Tuple[int, int]] = None) -> np.ndarray:
+        """The full grid as host uint8 (H, W); optionally block-max downsampled
+        *on device* to fit within ``max_shape`` before transfer, so rendering
+        a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
+        dense = (
+            bitpack.unpack(self._state) if self.backend == "packed" else self._state
+        )
+        if max_shape is not None:
+            dense = _downsample_max(dense, max_shape)
+        return np.asarray(dense)
+
+    def population(self) -> int:
+        """Exact live-cell count (device-side popcount, host-side total)."""
+        if self.backend == "packed":
+            return bitpack.population(self._state)
+        return int(np.asarray(jnp.sum(self._state, axis=-1, dtype=jnp.uint32)).sum())
+
+    # -- state injection (checkpoint restore, pattern editing) ---------------
+
+    def set_grid(self, grid, generation: Optional[int] = None) -> None:
+        grid = jnp.asarray(np.asarray(grid, dtype=np.uint8))
+        if tuple(grid.shape) != self.shape:
+            raise ValueError(f"grid shape {grid.shape} != engine shape {self.shape}")
+        state = bitpack.pack(grid) if self.backend == "packed" else grid
+        if self.mesh is not None:
+            state = mesh_lib.device_put_sharded_grid(state, self.mesh)
+        self._state = state
+        if generation is not None:
+            self.generation = generation
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _block_max(x: jax.Array, fh: int, fw: int) -> jax.Array:
+    h, w = x.shape
+    return (
+        x[: h - h % fh, : w - w % fw]
+        .reshape(h // fh, fh, w // fw, fw)
+        .max(axis=(1, 3))
+    )
+
+
+def _downsample_max(dense: jax.Array, max_shape: Tuple[int, int]) -> jax.Array:
+    """Block-max pool so any live cell keeps its block lit (a renderer that
+    averaged would fade sparse patterns like a lone glider to nothing)."""
+    h, w = dense.shape
+    mh, mw = max_shape
+    fh, fw = max(1, -(-h // mh)), max(1, -(-w // mw))
+    if fh == 1 and fw == 1:
+        return dense
+    return _block_max(dense, fh, fw)

@@ -1,0 +1,82 @@
+"""Structured per-step metrics with pluggable sinks (SURVEY.md §6).
+
+The reference's only observability is its console renderer [META]; here
+every tick can emit a structured record — generations/sec, cell-updates/sec,
+optional population — to stdout JSONL, CSV, or an in-memory buffer (used by
+tests and the bench harness). Sinks are deliberately dumb callables so a
+profiler/trace exporter can be hung on the same bus.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import io
+import json
+import sys
+from typing import Callable, List, Optional, TextIO
+
+
+@dataclasses.dataclass(frozen=True)
+class StepMetrics:
+    generation: int                    # generation counter after the step
+    generations_stepped: int           # generations covered by this record
+    wall_seconds: float
+    cell_updates_per_sec: float
+    population: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.population is None:
+            d.pop("population")
+        return d
+
+
+Sink = Callable[[StepMetrics], None]
+
+
+class JsonlSink:
+    """One JSON object per record, e.g. for `tail -f` or log shipping."""
+
+    def __init__(self, stream: Optional[TextIO] = None):
+        self.stream = stream if stream is not None else sys.stdout
+
+    def __call__(self, m: StepMetrics) -> None:
+        self.stream.write(json.dumps(m.to_dict()) + "\n")
+        self.stream.flush()
+
+
+class CsvSink:
+    def __init__(self, stream: TextIO):
+        self.stream = stream
+        self._writer = None
+
+    def __call__(self, m: StepMetrics) -> None:
+        row = dataclasses.asdict(m)
+        if self._writer is None:
+            self._writer = csv.DictWriter(self.stream, fieldnames=list(row))
+            self._writer.writeheader()
+        self._writer.writerow(row)
+        self.stream.flush()
+
+
+class BufferSink:
+    """Keeps records in memory — tests and the bench harness read these."""
+
+    def __init__(self):
+        self.records: List[StepMetrics] = []
+
+    def __call__(self, m: StepMetrics) -> None:
+        self.records.append(m)
+
+
+class MetricsLogger:
+    def __init__(self, *sinks: Sink):
+        self.sinks: List[Sink] = list(sinks)
+
+    def add_sink(self, sink: Sink) -> None:
+        self.sinks.append(sink)
+
+    def log(self, m: StepMetrics) -> None:
+        for s in self.sinks:
+            s(m)

@@ -1,0 +1,54 @@
+"""Console renderer — the reference's Renderer role, off the critical path.
+
+The reference prints every generation from its coordinator (SURVEY.md §4c)
+and console I/O can dominate wall-clock; here the renderer is just another
+subscriber fed already-downsampled frames (Engine.snapshot does device-side
+block-max pooling), so a 16384² universe costs a ~2 KB transfer per drawn
+frame. ANSI mode redraws in place; plain mode appends (pipe-friendly).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, TextIO
+
+from ..coordinator import RenderFrame
+
+_ANSI_HOME = "\x1b[H"
+_ANSI_CLEAR = "\x1b[2J"
+
+
+class ConsoleRenderer:
+    """Draws frames as text. ``charset``: (dead, alive) glyphs."""
+
+    def __init__(
+        self,
+        stream: Optional[TextIO] = None,
+        *,
+        ansi: Optional[bool] = None,
+        charset: str = "·█",
+    ):
+        self.stream = stream if stream is not None else sys.stdout
+        self.ansi = self.stream.isatty() if ansi is None else ansi
+        if len(charset) != 2:
+            raise ValueError("charset must be exactly (dead, alive) two glyphs")
+        self.charset = charset
+        self._first = True
+
+    def __call__(self, frame: RenderFrame) -> None:
+        out = []
+        if self.ansi:
+            out.append(_ANSI_CLEAR + _ANSI_HOME if self._first else _ANSI_HOME)
+        dead, alive = self.charset
+        for row in frame.grid:
+            out.append("".join(alive if v else dead for v in row))
+            out.append("\n")
+        status = f"gen {frame.generation}  grid {frame.full_shape[0]}x{frame.full_shape[1]}"
+        if frame.grid.shape != frame.full_shape:
+            status += f"  (view {frame.grid.shape[0]}x{frame.grid.shape[1]})"
+        if frame.population is not None:
+            status += f"  pop {frame.population}"
+        out.append(status + "\n")
+        self.stream.write("".join(out))
+        self.stream.flush()
+        self._first = False

@@ -1,0 +1,122 @@
+"""Checkpoint/resume exactness, config assembly, and CLI end-to-end."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu import Engine, SimulationConfig
+from gameoflifewithactors_tpu.cli import main as cli_main
+from gameoflifewithactors_tpu.config import from_args
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+
+def test_checkpoint_roundtrip_exact(tmp_path):
+    rng = np.random.default_rng(9)
+    g = rng.integers(0, 2, size=(48, 96), dtype=np.uint8)
+    e = Engine(g, "highlife", topology=Topology.DEAD)
+    e.step(7)
+    path = ckpt.save(e, tmp_path / "ck.npz")
+
+    e2 = ckpt.load_engine(path)
+    assert e2.generation == 7
+    assert e2.rule == e.rule and e2.topology == Topology.DEAD
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+
+    # resumed run continues exactly as the original would
+    e.step(5)
+    e2.step(5)
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+
+
+def test_checkpoint_cross_backend_and_mesh(tmp_path):
+    g = seeds.seeded((32, 256), "gosper_gun", 4, 4)
+    e = Engine(g, "conway", backend="dense")
+    e.step(30)
+    path = ckpt.save(e, tmp_path / "ck.npz")
+
+    m = mesh_lib.make_mesh((2, 4))
+    e2 = ckpt.load_engine(path, mesh=m, backend="packed")
+    e.step(30)
+    e2.step(30)
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+
+
+def test_checkpoint_version_guard(tmp_path):
+    p = tmp_path / "bad.npz"
+    np.savez(p, bits=np.zeros((1, 1), np.uint8), meta=json.dumps({"version": 99}))
+    with pytest.raises(ValueError, match="version"):
+        ckpt.load_grid(p)
+
+
+def test_config_build_defaults():
+    cfg = SimulationConfig(height=16, width=32, seed="glider")
+    coordinator, scheduler = cfg.build()
+    assert coordinator.shape == (16, 32)
+    coordinator.tick()
+    assert coordinator.population() == 5
+
+
+def test_config_random_fill_overrides_default_seed():
+    # regression: the default seed='glider' must not conflict with random_fill
+    cfg = SimulationConfig(height=16, width=32, random_fill=0.3)
+    c, _ = cfg.build()
+    assert 0 < c.population() < 16 * 32
+
+
+def test_config_mesh_parsing():
+    cfg = SimulationConfig(mesh="2x4", height=16, width=256)
+    c, _ = cfg.build()
+    assert c.engine.mesh is not None
+    with pytest.raises(ValueError):
+        SimulationConfig(mesh="banana").build_mesh()
+
+
+def test_from_args_roundtrip():
+    cfg, args = from_args(
+        ["--grid", "128x128", "--rule", "highlife", "--seed", "random",
+         "--random-p", "0.3", "--steps", "17", "--mesh", "auto",
+         "--topology", "dead", "--population"]
+    )
+    assert (cfg.height, cfg.width) == (128, 128)
+    assert cfg.random_fill == 0.3 and cfg.seed is None
+    assert cfg.steps == 17 and cfg.track_population
+    assert cfg.topology == "dead"
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    ck = tmp_path / "end.npz"
+    rc = cli_main(
+        ["--grid", "32x64", "--seed", "glider", "--steps", "8",
+         "--render", "final", "--population", "--checkpoint", str(ck)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gen 8" in out and "pop 5" in out
+    grid, meta = ckpt.load_grid(ck)
+    assert meta["generation"] == 8
+    assert grid.sum() == 5
+
+
+def test_cli_resume(tmp_path):
+    ck = tmp_path / "resume.npz"
+    cli_main(["--grid", "32x64", "--seed", "glider", "--steps", "4",
+              "--checkpoint", str(ck)])
+    rc = cli_main(["--resume", str(ck), "--steps", "4", "--checkpoint", str(ck)])
+    assert rc == 0
+    grid, meta = ckpt.load_grid(ck)
+    assert meta["generation"] == 8
+    # 8 generations total = glider moved (2, 2)
+    want = np.roll(seeds.seeded((32, 64), "glider", 14, 30), (2, 2), (0, 1))
+    np.testing.assert_array_equal(grid, want)
+
+
+def test_cli_rle_seed(tmp_path):
+    rle = tmp_path / "p.rle"
+    rle.write_text("x = 3, y = 3\nbob$2bo$3o!")
+    rc = cli_main(["--grid", "32x64", "--seed", f"@{rle}", "--steps", "4"])
+    assert rc == 0

@@ -1,0 +1,168 @@
+"""Engine / GridCoordinator / TickScheduler / renderer behavior tests.
+
+These exercise the reference-shaped surface (SURVEY.md §1 API-boundary row):
+construct → tick → snapshot/subscribe, across backends and meshes.
+"""
+
+import io
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu import (
+    Engine,
+    GridCoordinator,
+    TickScheduler,
+)
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+from gameoflifewithactors_tpu.utils.metrics import BufferSink, MetricsLogger
+from gameoflifewithactors_tpu.utils.render import ConsoleRenderer
+
+
+@pytest.mark.parametrize("backend", ["packed", "dense"])
+def test_engine_step_and_snapshot(backend):
+    g = seeds.seeded((16, 32), "glider", 2, 2)
+    e = Engine(g, "conway", backend=backend)
+    e.step(4)
+    assert e.generation == 4
+    np.testing.assert_array_equal(e.snapshot(), np.roll(g, (1, 1), (0, 1)))
+    assert e.population() == 5
+
+
+def test_engine_sharded_backend():
+    m = mesh_lib.make_mesh((2, 4))
+    g = seeds.seeded((16, 256), "glider", 2, 2)
+    e = Engine(g, "conway", mesh=m)
+    e.step(4)
+    np.testing.assert_array_equal(e.snapshot(), np.roll(g, (1, 1), (0, 1)))
+
+
+def test_engine_rejects_bad_args():
+    with pytest.raises(ValueError):
+        Engine(np.zeros((4, 32), np.uint8), "conway", backend="warp")
+    with pytest.raises(ValueError):
+        Engine(np.zeros((4, 4, 4), np.uint8), "conway")
+    e = Engine(np.zeros((4, 32), np.uint8), "conway")
+    with pytest.raises(ValueError):
+        e.step(-1)
+    e.step(0)
+    assert e.generation == 0
+
+
+def test_engine_snapshot_downsample_keeps_sparse_life():
+    g = seeds.seeded((64, 64), "glider", 1, 1)
+    e = Engine(g, "conway")
+    view = e.snapshot(max_shape=(8, 8))
+    assert view.shape == (8, 8)
+    assert view.sum() >= 1  # block-max: the lone glider must stay visible
+
+
+def test_engine_set_grid_shape_check():
+    e = Engine(np.zeros((8, 32), np.uint8), "conway")
+    with pytest.raises(ValueError):
+        e.set_grid(np.zeros((8, 64), np.uint8))
+
+
+def test_coordinator_centers_seed_and_runs():
+    c = GridCoordinator((32, 64), "conway", seed="blinker")
+    pop0 = c.population()
+    c.tick()
+    assert c.generation == 1
+    assert c.population() == pop0 == 3
+
+
+def test_coordinator_random_fill_and_conflict():
+    c = GridCoordinator((64, 64), "conway", random_fill=0.5, rng_seed=1)
+    assert 0.4 < c.population() / (64 * 64) < 0.6
+    with pytest.raises(ValueError):
+        GridCoordinator((8, 32), "conway", seed="glider", random_fill=0.5)
+
+
+def test_coordinator_subscribe_and_frames():
+    frames = []
+    c = GridCoordinator((16, 32), "conway", seed="glider", track_population=True,
+                        view_shape=(8, 8))
+    unsub = c.subscribe(frames.append)
+    c.run(8, render_every=2)
+    assert [f.generation for f in frames] == [2, 4, 6, 8]
+    assert all(f.population == 5 for f in frames)
+    assert frames[0].grid.shape == (8, 8)
+    assert frames[0].full_shape == (16, 32)
+    unsub()
+    c.tick()
+    assert len(frames) == 4  # unsubscribed: no more frames
+
+
+def test_coordinator_metrics():
+    buf = BufferSink()
+    c = GridCoordinator((32, 32), "conway", random_fill=0.3,
+                        metrics=MetricsLogger(buf), track_population=True)
+    c.run(10, render_every=5)
+    assert len(buf.records) == 2
+    r = buf.records[-1]
+    assert r.generation == 10 and r.generations_stepped == 5
+    assert r.cell_updates_per_sec > 0
+    assert r.population is not None
+
+
+def test_scheduler_run_and_controls():
+    c = GridCoordinator((16, 32), "conway", seed="glider")
+    s = TickScheduler(c)
+    assert s.run(max_generations=12) == 12
+    assert c.generation == 12
+
+    s2 = TickScheduler(c, generations_per_tick=5)
+    assert s2.run(max_generations=12) == 12  # clamps the last tick
+    assert c.generation == 24
+
+
+def test_scheduler_pause_resume_stop_threaded():
+    c = GridCoordinator((16, 32), "conway", seed="glider")
+    s = TickScheduler(c, rate_hz=500.0)
+    t = threading.Thread(target=s.run)
+    s.pause()
+    t.start()
+    gen_while_paused = c.generation
+    s.step_once()
+    assert c.generation == gen_while_paused + 1
+    s.resume()
+    while c.generation < gen_while_paused + 3:
+        pass
+    s.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_scheduler_completed_run_returns_even_if_paused():
+    # regression: pausing at the finish line must not hang run()
+    c = GridCoordinator((16, 32), "conway", seed="glider")
+    s = TickScheduler(c)
+    s.pause()
+    t = threading.Thread(target=lambda: s.run(max_generations=0))
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_scheduler_validation():
+    c = GridCoordinator((8, 32), "conway")
+    with pytest.raises(ValueError):
+        TickScheduler(c, rate_hz=0)
+    with pytest.raises(ValueError):
+        TickScheduler(c, generations_per_tick=0)
+
+
+def test_console_renderer_output():
+    c = GridCoordinator((8, 32), "conway", seed="block", track_population=True)
+    out = io.StringIO()
+    c.subscribe(ConsoleRenderer(out, ansi=False, charset=".#"))
+    c.tick()
+    text = out.getvalue()
+    assert "##" in text
+    assert "gen 1" in text and "pop 4" in text
+    with pytest.raises(ValueError):
+        ConsoleRenderer(out, charset="###")
