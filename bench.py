@@ -1,0 +1,89 @@
+"""Benchmark harness: north-star cell-updates/sec/chip (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.json "published": {});
+the driver's north-star target is >=1e9 cell-updates/sec/chip on a 16384^2
+grid (v5e-1), so ``vs_baseline`` reports value / 1e9 — i.e. 1.0 means the
+target is exactly met. The measured Akka-style actor baseline lives in
+baselines/ and BASELINE.md, not here: this file times the flagship device
+path only, with the generation loop fully on-device (multi_step_packed) so
+host dispatch and readback are off the measured path, matching SURVEY.md
+§8's "benchmarks measure the stencil, not console I/O".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+NORTH_STAR_TARGET = 1e9  # cell-updates/sec/chip, 16384^2 (BASELINE.json)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", type=int, default=None,
+                    help="grid side length (default: 16384 on TPU, 4096 on CPU)")
+    ap.add_argument("--gens", type=int, default=None,
+                    help="generations per timed repetition (default: autotuned)")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--backend", choices=["packed", "dense"], default="packed")
+    ap.add_argument("--rule", default="B3/S23")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.rules import parse_rule
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+    platform = jax.devices()[0].platform
+    side = args.size or (16384 if platform != "cpu" else 4096)
+    rule = parse_rule(args.rule)
+
+    rng = np.random.default_rng(0)
+    grid = rng.integers(0, 2, size=(side, side), dtype=np.uint8)
+    if args.backend == "packed":
+        state = bitpack.pack(jnp.asarray(grid))
+        run = lambda s, n: multi_step_packed(s, n, rule=rule, topology=Topology.TORUS)
+    else:
+        state = jnp.asarray(grid)
+        run = lambda s, n: multi_step(s, n, rule=rule, topology=Topology.TORUS)
+
+    # warmup: compile + one generation
+    state = run(state, 1)
+    state.block_until_ready()
+
+    gens = args.gens
+    if gens is None:
+        # autotune: aim for ~2s per repetition
+        t0 = time.perf_counter()
+        state = run(state, 10)
+        state.block_until_ready()
+        per_gen = (time.perf_counter() - t0) / 10
+        gens = max(10, min(2000, int(2.0 / max(per_gen, 1e-7))))
+
+    cells = side * side
+    best = 0.0
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        state = run(state, gens)
+        state.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, cells * gens / dt)
+
+    print(json.dumps({
+        "metric": f"cell-updates/sec/chip, {side}x{side} {rule.notation} ({args.backend}, {platform})",
+        "value": best,
+        "unit": "cell-updates/sec",
+        "vs_baseline": best / NORTH_STAR_TARGET,
+    }))
+
+
+if __name__ == "__main__":
+    main()

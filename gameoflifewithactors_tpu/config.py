@@ -50,10 +50,13 @@ class SimulationConfig:
             return None
         if self.mesh == "auto":
             return mesh_lib.make_mesh()
-        m = re.fullmatch(r"(\d+)x(\d+)", self.mesh)
-        if not m:
-            raise ValueError(f"--mesh must be 'auto' or like '2x4', got {self.mesh!r}")
-        return mesh_lib.make_mesh((int(m.group(1)), int(m.group(2))))
+        try:
+            shape = _parse_geometry(self.mesh)
+        except argparse.ArgumentTypeError:
+            raise ValueError(
+                f"--mesh must be 'auto' or like '2x4', got {self.mesh!r}"
+            ) from None
+        return mesh_lib.make_mesh(shape)
 
     def build_metrics(self):
         from .utils import metrics as metrics_lib

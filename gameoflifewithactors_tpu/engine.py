@@ -64,6 +64,18 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
+        if mesh is not None:
+            # validate in *cell* units before packing, so the error names the
+            # user's grid shape, not the packed word shape
+            nx = mesh.shape[mesh_lib.ROW_AXIS]
+            ny = mesh.shape[mesh_lib.COL_AXIS]
+            wq = bitpack.WORD * ny if backend == "packed" else ny
+            if self.shape[0] % nx or self.shape[1] % wq:
+                raise ValueError(
+                    f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
+                    f"need height % {nx} == 0 and width % {wq} == 0"
+                    + (" (packed backend shards 32-cell words)" if backend == "packed" else "")
+                )
         state = bitpack.pack(grid) if backend == "packed" else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
@@ -142,9 +154,13 @@ from functools import partial
 @partial(jax.jit, static_argnums=(1, 2))
 def _block_max(x: jax.Array, fh: int, fw: int) -> jax.Array:
     h, w = x.shape
+    # pad up to a block multiple (zeros are dead cells) so edge rows/columns
+    # land in a partial block instead of being cropped away
+    ph, pw = -h % fh, -w % fw
+    if ph or pw:
+        x = jnp.pad(x, ((0, ph), (0, pw)))
     return (
-        x[: h - h % fh, : w - w % fw]
-        .reshape(h // fh, fh, w // fw, fw)
+        x.reshape((h + ph) // fh, fh, (w + pw) // fw, fw)
         .max(axis=(1, 3))
     )
 

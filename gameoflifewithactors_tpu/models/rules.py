@@ -85,9 +85,16 @@ def parse_rule(spec: "str | Rule") -> Rule:
     key = text.lower().replace(" ", "").replace("&", "and").replace("'", "")
     if key in RULE_REGISTRY:
         return RULE_REGISTRY[key]
-    m = _BS_RE.match(text.replace(" ", ""))
+    compact = text.replace(" ", "")
+    m = _BS_RE.match(compact)
     if m is None:
-        m = _SB_RE.match(text.replace(" ", ""))
+        # classic S/B form is typo-prone ('23/' for '23/3'), so unlike the
+        # explicit lettered form it must name both digit groups
+        m = _SB_RE.match(compact)
+        if m is not None and not (m.group("b") and m.group("s")):
+            m = None
+    if m is not None and not (m.group("b") or m.group("s")):
+        m = None  # bare 'B/S' or '/': nothing specified
     if m is None:
         raise ValueError(
             f"unrecognized rule {spec!r}; expected B/S notation like 'B3/S23' "

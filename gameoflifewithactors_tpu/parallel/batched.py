@@ -1,0 +1,66 @@
+"""Batch-of-universes data parallelism (the DP axis of SURVEY.md §3).
+
+The reference has no batch concept [ABSENT] — one actor system is one
+universe. Here a leading batch axis turns the framework into a rule-sweep /
+ensemble machine: (B, H, W/32) grids shard as P('b', 'x', 'y') over a 3D
+mesh — batch members are embarrassingly parallel (pure DP, no collectives
+on 'b'), while each member's tiles still exchange halos over the spatial
+axes. Inside the per-device tile the spatial step is vmapped over the local
+batch, so the same core plane-extraction code serves 1 universe or 1000.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.rules import Rule
+from ..ops.packed import step_packed_ext
+from ..ops.stencil import Topology
+from .halo import exchange_halo
+from .mesh import COL_AXIS, ROW_AXIS
+
+BATCH_AXIS = "b"
+_SPEC = P(BATCH_AXIS, ROW_AXIS, COL_AXIS)
+
+
+def make_batch_mesh(
+    shape: Tuple[int, int, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (b, x, y) mesh: batch-parallel replicas of spatial tile grids."""
+    devices = list(devices if devices is not None else jax.devices())
+    nb, nx, ny = shape
+    if nb * nx * ny != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {nb * nx * ny} devices, have {len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices).reshape(nb, nx, ny), (BATCH_AXIS, ROW_AXIS, COL_AXIS)
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, _SPEC)
+
+
+def make_multi_step_packed_batched(
+    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS
+) -> Callable:
+    """Jitted (grids, n) -> grids over a (B, H, W/32) packed batch."""
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+
+    def universe_gen(tile):
+        return step_packed_ext(exchange_halo(tile, nx, ny, topology), rule)
+
+    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
+    def _run(tiles, n):
+        gen = jax.vmap(universe_gen)
+        return jax.lax.fori_loop(0, n, lambda _, t: gen(t), tiles)
+
+    return jax.jit(_run, donate_argnums=0)

@@ -61,6 +61,22 @@ def test_engine_snapshot_downsample_keeps_sparse_life():
     assert view.sum() >= 1  # block-max: the lone glider must stay visible
 
 
+def test_engine_snapshot_downsample_keeps_edge_cells():
+    # regression: edge rows/cols must land in a partial block, not be cropped
+    g = np.zeros((100, 64), np.uint8)
+    g[-1, :] = 1
+    e = Engine(g, "conway")
+    e.step(0)
+    view = np.asarray(e.snapshot(max_shape=(40, 80)))
+    assert view[-1].sum() > 0
+
+
+def test_engine_mesh_divisibility_error_in_cell_units():
+    m = mesh_lib.make_mesh((1, 4), jax.devices()[:4])
+    with pytest.raises(ValueError, match=r"width % 128"):
+        Engine(np.zeros((64, 64), np.uint8), "conway", mesh=m)
+
+
 def test_engine_set_grid_shape_check():
     e = Engine(np.zeros((8, 32), np.uint8), "conway")
     with pytest.raises(ValueError):

@@ -38,6 +38,15 @@ def test_parse_rejects_garbage():
         parse_rule("nonsense")
 
 
+def test_parse_rejects_degenerate_typos():
+    for bad in ("/", "23/", "/3", "B/S"):
+        with pytest.raises(ValueError):
+            parse_rule(bad)
+    # but explicit lettered forms with one empty side are legitimate rules
+    assert parse_rule("B2/S").born == frozenset({2})
+    assert parse_rule("B/S23").survive == frozenset({2, 3})
+
+
 def test_notation_roundtrip():
     for r in (CONWAY, HIGHLIFE, DAY_AND_NIGHT):
         assert parse_rule(r.notation) == r
