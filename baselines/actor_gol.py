@@ -1,0 +1,251 @@
+"""Actor-per-cell Game of Life — the measured CPU baseline (config #1).
+
+A faithful miniature of the reference's architecture (SURVEY.md §1/§4,
+reconstructed from BASELINE.json's north_star: per-cell actors, neighbor
+``Tell`` messages, coordinator tick barrier): every cell is an actor with a
+mailbox-serialized receive; each generation the coordinator broadcasts
+Tick, every cell Tells its alive/dead state to its 8 Moore neighbors, and
+the coordinator barriers before the next generation. This keeps the cost
+profile the reference pays — O(9·N·M) mailbox messages per generation —
+which is exactly the cost the TPU stencil deletes.
+
+Generation protocol (two barriers, so no message can cross a generation
+boundary — a single barrier races: a fast neighbor's report can overtake a
+slow cell's own Tick, and a cell that applied its rule early would
+broadcast next-generation state):
+
+1. host resets per-cell counters while the system is quiescent, then
+   broadcasts TICK. A cell's TICK handler Tells its *current* state to all
+   neighbors and reports PHASE_DONE; its NEIGHBOR handler only accumulates
+   and reports PHASE_DONE when all reports are in. Coordinator barriers on
+   both kinds (2·N·M).
+2. host broadcasts COMMIT; each cell applies B3/S23 to its accumulated
+   count and replies with its new state; coordinator barriers on N·M.
+
+This is *deliberately* an actor runtime, not a NumPy loop: the baseline we
+compare against is mailbox dispatch, and BASELINE.md requires the build to
+measure it since the reference publishes no numbers. A worker pool drains a
+shared run queue and executes each actor's receive under its own mailbox
+lock (actor isolation: one message at a time per actor), like a miniature
+Akka dispatcher.
+
+Run:  python -m baselines.actor_gol [--size 64] [--gens 100] [--workers 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+TICK = "tick"
+NEIGHBOR = "neighbor"
+COMMIT = "commit"
+PHASE_DONE = "phase_done"
+COMMIT_DONE = "commit_done"
+DONE_TOKEN = object()
+
+
+class CellActor:
+    """One grid cell: state + mailbox-serialized receive (like the
+    reference's CellActor, whose mailbox serializes per-cell updates)."""
+
+    __slots__ = ("alive", "neighbors", "pending", "live_reports", "coordinator", "lock")
+
+    def __init__(self, alive: int):
+        self.alive = alive
+        self.neighbors: List["CellActor"] = []
+        self.pending = 0            # neighbor reports still awaited this tick
+        self.live_reports = 0       # live-neighbor count accumulated
+        self.coordinator: Optional["GridCoordinatorActor"] = None
+        self.lock = threading.Lock()
+
+    def receive(self, runtime: "ActorRuntime", kind: str, payload: int) -> None:
+        if kind == TICK:
+            for n in self.neighbors:
+                runtime.tell(n, NEIGHBOR, self.alive)
+            runtime.tell(self.coordinator, PHASE_DONE, 0)
+            if not self.neighbors:  # isolated cell: reports trivially complete
+                runtime.tell(self.coordinator, PHASE_DONE, 0)
+        elif kind == NEIGHBOR:
+            self.live_reports += payload
+            self.pending -= 1
+            if self.pending == 0:
+                runtime.tell(self.coordinator, PHASE_DONE, 0)
+        elif kind == COMMIT:
+            count = self.live_reports
+            if self.alive:
+                self.alive = 1 if count in (2, 3) else 0
+            else:
+                self.alive = 1 if count == 3 else 0
+            runtime.tell(self.coordinator, COMMIT_DONE, self.alive)
+
+
+class GridCoordinatorActor:
+    """Barriers each generation phase, like the reference's reply-counting
+    GridCoordinator."""
+
+    def __init__(self, n_cells: int):
+        self.n_cells = n_cells
+        self.remaining = 0
+        self.population = 0
+        self.phase_complete = threading.Event()
+        self.lock = threading.Lock()
+
+    def receive(self, runtime: "ActorRuntime", kind: str, payload: int) -> None:
+        if kind == PHASE_DONE or kind == COMMIT_DONE:
+            self.population += payload
+            self.remaining -= 1
+            if self.remaining == 0:
+                self.phase_complete.set()
+
+    def arm(self, expected: int) -> None:
+        """Called from the host between phases (system quiescent)."""
+        self.remaining = expected
+        self.population = 0
+        self.phase_complete.clear()
+
+
+class ActorRuntime:
+    """Minimal dispatcher: worker threads drain a shared run queue; each
+    delivery runs under the target actor's lock (mailbox serialization)."""
+
+    def __init__(self, workers: int):
+        self.queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.threads = [
+            threading.Thread(target=self._work, daemon=True) for _ in range(workers)
+        ]
+        for t in self.threads:
+            t.start()
+
+    def tell(self, actor, kind: str, payload: int) -> None:
+        self.queue.put((actor, kind, payload))
+
+    def _work(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is DONE_TOKEN:
+                return
+            actor, kind, payload = item
+            with actor.lock:
+                actor.receive(self, kind, payload)
+
+    def shutdown(self) -> None:
+        for _ in self.threads:
+            self.queue.put(DONE_TOKEN)
+        for t in self.threads:
+            t.join()
+
+
+class ActorGrid:
+    """Program/ActorSystem analogue: builds the grid, wires neighborhoods,
+    drives ticks."""
+
+    def __init__(self, grid: np.ndarray, workers: int = 4, torus: bool = True):
+        h, w = grid.shape
+        self.shape = (h, w)
+        self.runtime = ActorRuntime(workers)
+        self.coordinator = GridCoordinatorActor(h * w)
+        self.cells = [[CellActor(int(grid[r, c])) for c in range(w)] for r in range(h)]
+        for r in range(h):
+            for c in range(w):
+                cell = self.cells[r][c]
+                cell.coordinator = self.coordinator
+                for dr in (-1, 0, 1):
+                    for dc in (-1, 0, 1):
+                        if (dr, dc) == (0, 0):
+                            continue
+                        rr, cc = r + dr, c + dc
+                        if torus:
+                            cell.neighbors.append(self.cells[rr % h][cc % w])
+                        elif 0 <= rr < h and 0 <= cc < w:
+                            cell.neighbors.append(self.cells[rr][cc])
+        self.generation = 0
+
+    def tick(self) -> int:
+        """One generation; returns the new population."""
+        n_cells = self.shape[0] * self.shape[1]
+        # phase 1: reset (quiescent — both barriers below drain the queue),
+        # broadcast, accumulate
+        for row in self.cells:
+            for cell in row:
+                cell.pending = len(cell.neighbors)
+                cell.live_reports = 0
+        self.coordinator.arm(2 * n_cells)
+        for row in self.cells:
+            for cell in row:
+                self.runtime.tell(cell, TICK, 0)
+        self.coordinator.phase_complete.wait()
+        # phase 2: commit the rule everywhere
+        self.coordinator.arm(n_cells)
+        for row in self.cells:
+            for cell in row:
+                self.runtime.tell(cell, COMMIT, 0)
+        self.coordinator.phase_complete.wait()
+        self.generation += 1
+        return self.coordinator.population
+
+    def run(self, generations: int) -> int:
+        pop = 0
+        for _ in range(generations):
+            pop = self.tick()
+        return pop
+
+    def snapshot(self) -> np.ndarray:
+        h, w = self.shape
+        out = np.zeros((h, w), dtype=np.uint8)
+        for r in range(h):
+            for c in range(w):
+                out[r, c] = self.cells[r][c].alive
+        return out
+
+    def shutdown(self) -> None:
+        self.runtime.shutdown()
+
+
+def measure(size: int = 64, gens: int = 100, workers: int = 4, seed: str = "glider") -> dict:
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from gameoflifewithactors_tpu.models import seeds as seeds_lib
+
+    if seed == "glider":
+        grid = seeds_lib.seeded((size, size), "glider", 1, 1)
+    else:
+        grid = (np.random.default_rng(0).random((size, size)) < 0.5).astype(np.uint8)
+
+    sim = ActorGrid(grid, workers=workers)
+    sim.run(3)  # warmup
+    t0 = time.perf_counter()
+    sim.run(gens)
+    dt = time.perf_counter() - t0
+    sim.shutdown()
+    rate = size * size * gens / dt
+    return {
+        "metric": f"actor-per-cell baseline, {size}x{size} Conway glider ({workers} workers)",
+        "value": rate,
+        "unit": "cell-updates/sec",
+        "messages_per_generation": 13 * size * size,
+        "wall_seconds": dt,
+        "generations": gens,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--gens", type=int, default=100)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--seed", default="glider")
+    args = ap.parse_args()
+    print(json.dumps(measure(args.size, args.gens, args.workers, args.seed)))
+
+
+if __name__ == "__main__":
+    main()

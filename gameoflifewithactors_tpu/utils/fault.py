@@ -1,0 +1,123 @@
+"""Fault injection + checkpoint-based recovery (SURVEY.md §6).
+
+The reference's fault story is Akka supervision restarting a crashed cell
+actor — which silently loses that cell's state [RECON]. The SPMD
+equivalent of "a crashed actor" is a corrupted/lost shard, and the honest
+recovery story is checkpoint-based restart: GuardedRun snapshots every k
+generations and, when a validator rejects the state (or stepping raises),
+rolls back to the last good checkpoint and replays. Tests use the
+injectors to corrupt state mid-run and prove recovery is bit-exact.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..engine import Engine
+from . import checkpoint as ckpt_lib
+
+Validator = Callable[[Engine], bool]
+
+
+# -- injectors (test hooks) --------------------------------------------------
+
+def corrupt_region(engine: Engine, top: int, left: int, h: int, w: int, seed: int = 0) -> None:
+    """Overwrite a rectangle with random bits — a 'shard went bad' fault."""
+    grid = engine.snapshot().copy()
+    rng = np.random.default_rng(seed)
+    grid[top : top + h, left : left + w] = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
+    engine.set_grid(grid)
+
+
+def drop_region(engine: Engine, top: int, left: int, h: int, w: int) -> None:
+    """Zero a rectangle — a 'lost shard / restarted actor' fault (what Akka
+    supervision's restart would leave behind: default-initialized state)."""
+    grid = engine.snapshot().copy()
+    grid[top : top + h, left : left + w] = 0
+    engine.set_grid(grid)
+
+
+# -- validators --------------------------------------------------------------
+
+def population_bounds_validator(min_pop: int = 0, max_pop: Optional[int] = None) -> Validator:
+    """Reject states whose population leaves [min_pop, max_pop] — the cheap
+    invariant check (exact popcount is one device reduction)."""
+
+    def validate(engine: Engine) -> bool:
+        pop = engine.population()
+        if pop < min_pop:
+            return False
+        if max_pop is not None and pop > max_pop:
+            return False
+        return True
+
+    return validate
+
+
+# -- guarded execution -------------------------------------------------------
+
+class GuardedRun:
+    """Checkpoint-every-k stepping with rollback-and-replay on failure.
+
+    ``validator`` is consulted after each chunk; a False verdict (or an
+    exception from the engine) triggers restore from the last good
+    checkpoint. ``on_recover`` is called with the generation rolled back
+    to (observability hook).
+    """
+
+    def __init__(
+        self,
+        engine: Engine,
+        *,
+        checkpoint_every: int = 100,
+        checkpoint_path: Optional[str] = None,
+        validator: Optional[Validator] = None,
+        on_recover: Optional[Callable[[int], None]] = None,
+        max_retries: int = 3,
+    ):
+        self.engine = engine
+        self.checkpoint_every = checkpoint_every
+        self.validator = validator
+        self.on_recover = on_recover
+        self.max_retries = max_retries
+        self.recoveries = 0
+        if checkpoint_path is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="gol_guard_")
+            checkpoint_path = str(Path(self._tmp.name) / "guard.npz")
+        self.checkpoint_path = checkpoint_path
+        ckpt_lib.save(self.engine, self.checkpoint_path)  # gen-0 restore point
+
+    def _restore(self) -> None:
+        grid, meta = ckpt_lib.load_grid(self.checkpoint_path)
+        self.engine.set_grid(grid, generation=meta["generation"])
+        self.recoveries += 1
+        if self.on_recover is not None:
+            self.on_recover(self.engine.generation)
+
+    def run(self, generations: int) -> None:
+        target = self.engine.generation + generations
+        retries = 0
+        while self.engine.generation < target:
+            chunk = min(self.checkpoint_every, target - self.engine.generation)
+            last_exc: Optional[Exception] = None
+            try:
+                self.engine.step(chunk)
+                ok = self.validator(self.engine) if self.validator else True
+            except Exception as exc:  # surfaced at sync time under async dispatch
+                last_exc = exc
+                ok = False
+            if ok:
+                ckpt_lib.save(self.engine, self.checkpoint_path)
+                retries = 0
+            else:
+                if retries >= self.max_retries:
+                    raise RuntimeError(
+                        f"state validation failed {retries + 1}x in a row at "
+                        f"generation {self.engine.generation}; giving up"
+                    ) from last_exc
+                self._restore()
+                retries += 1

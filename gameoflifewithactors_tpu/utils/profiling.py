@@ -1,0 +1,78 @@
+"""Tracing/profiling hooks (SURVEY.md §6 'Tracing/profiling').
+
+The reference has none [ABSENT]; here the step loop can be wrapped in
+``jax.profiler`` traces (perfetto-compatible dumps readable in TensorBoard
+or ui.perfetto.dev) with named annotations around the phases that matter —
+step dispatch, device sync, snapshot readback — plus a lightweight
+wall-clock timer that needs no trace viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profile into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_steps(engine, generations: int, log_dir: str, chunk: int = 1) -> None:
+    """Trace a short stepped run: one annotated region per chunk, one sync
+    at the end (so the trace shows pipelined dispatch, not sync stalls)."""
+    with trace(log_dir):
+        done = 0
+        while done < generations:
+            n = min(chunk, generations - done)
+            with annotate(f"gol_step x{n}"):
+                engine.step(n)
+            done += n
+        with annotate("gol_sync"):
+            engine.block_until_ready()
+
+
+@dataclass
+class PhaseTimer:
+    """Wall-clock phase accumulator: ``with timer.phase("step"): ...``.
+
+    Per-phase totals/counts land in ``summary()`` — the no-dependencies
+    answer to "where did the wall-clock go" (device time needs trace()).
+    """
+
+    totals: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "total_s": self.totals[name],
+                "count": self.counts[name],
+                "mean_s": self.totals[name] / self.counts[name],
+            }
+            for name in self.totals
+        }

@@ -1,0 +1,41 @@
+"""The actor baseline must itself be a correct Game of Life — otherwise the
+speedup comparison in BASELINE.md is against a broken strawman."""
+
+import numpy as np
+
+from baselines.actor_gol import ActorGrid
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.models.rules import CONWAY
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+from .oracle import numpy_run
+
+
+def test_actor_glider_matches_oracle():
+    g = seeds.seeded((12, 12), "glider", 2, 2)
+    sim = ActorGrid(g, workers=4)
+    sim.run(8)
+    got = sim.snapshot()
+    sim.shutdown()
+    np.testing.assert_array_equal(got, numpy_run(g, CONWAY, Topology.TORUS, 8))
+
+
+def test_actor_random_soup_matches_oracle():
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 2, size=(10, 14), dtype=np.uint8)
+    sim = ActorGrid(g, workers=3)
+    pop = sim.run(5)
+    got = sim.snapshot()
+    sim.shutdown()
+    want = numpy_run(g, CONWAY, Topology.TORUS, 5)
+    np.testing.assert_array_equal(got, want)
+    assert pop == int(want.sum())
+
+
+def test_actor_dead_boundary():
+    g = seeds.seeded((8, 8), "blinker", 3, 3)
+    sim = ActorGrid(g, workers=2, torus=False)
+    sim.run(2)
+    got = sim.snapshot()
+    sim.shutdown()
+    np.testing.assert_array_equal(got, numpy_run(g, CONWAY, Topology.DEAD, 2))

@@ -1,0 +1,95 @@
+"""Aux subsystems: profiling hooks, fault injection + guarded recovery."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu import Engine
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.utils import fault
+from gameoflifewithactors_tpu.utils.profiling import PhaseTimer, profile_steps
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("step"):
+        pass
+    with t.phase("step"):
+        pass
+    with t.phase("sync"):
+        pass
+    s = t.summary()
+    assert s["step"]["count"] == 2 and s["sync"]["count"] == 1
+    assert s["step"]["total_s"] >= 0
+
+
+def test_profile_steps_writes_trace(tmp_path):
+    e = Engine(seeds.seeded((32, 32), "glider", 1, 1), "conway")
+    profile_steps(e, 4, str(tmp_path), chunk=2)
+    assert e.generation == 4
+    # jax wrote a profile tree under the log dir
+    walked = [p for p, _, files in os.walk(tmp_path) for f in files]
+    assert walked, "no profiler output written"
+
+
+def test_fault_injectors_change_state():
+    g = seeds.seeded((16, 32), "glider", 2, 2)
+    e = Engine(g, "conway")
+    fault.drop_region(e, 0, 0, 16, 32)
+    assert e.population() == 0
+    e2 = Engine(g, "conway")
+    fault.corrupt_region(e2, 0, 0, 8, 8, seed=3)
+    assert not np.array_equal(e2.snapshot(), g)
+
+
+def test_guarded_run_recovers_bit_exact(tmp_path):
+    """Corrupt the universe mid-run; GuardedRun must roll back and land on
+    exactly the state an unfaulted run reaches."""
+    g = seeds.seeded((32, 64), "gosper_gun", 4, 4)
+
+    clean = Engine(g, "conway")
+    clean.step(40)
+    want = clean.snapshot()
+
+    e = Engine(g, "conway")
+    injected = {"done": False}
+
+    def evil_validator(engine):
+        # after gen 20, inject one transient corruption and report failure
+        if engine.generation == 20 and not injected["done"]:
+            fault.corrupt_region(engine, 0, 0, 8, 8, seed=1)
+            injected["done"] = True
+            return False
+        return True
+
+    guard = fault.GuardedRun(
+        e,
+        checkpoint_every=10,
+        checkpoint_path=str(tmp_path / "g.npz"),
+        validator=evil_validator,
+    )
+    guard.run(40)
+    assert guard.recoveries == 1
+    assert e.generation == 40
+    np.testing.assert_array_equal(e.snapshot(), want)
+
+
+def test_guarded_run_gives_up_on_persistent_failure(tmp_path):
+    e = Engine(seeds.seeded((16, 32), "blinker", 4, 4), "conway")
+    guard = fault.GuardedRun(
+        e,
+        checkpoint_every=5,
+        checkpoint_path=str(tmp_path / "g.npz"),
+        validator=lambda _: False,  # permanently broken
+        max_retries=2,
+    )
+    with pytest.raises(RuntimeError, match="giving up"):
+        guard.run(10)
+
+
+def test_population_bounds_validator():
+    e = Engine(seeds.seeded((16, 32), "glider", 2, 2), "conway")
+    assert fault.population_bounds_validator(1, 100)(e)
+    assert not fault.population_bounds_validator(6, None)(e)
+    assert not fault.population_bounds_validator(0, 4)(e)
