@@ -30,16 +30,24 @@ def main() -> None:
     ap.add_argument("--gens", type=int, default=None,
                     help="generations per timed repetition (default: autotuned)")
     ap.add_argument("--repeats", type=int, default=3)
-    ap.add_argument("--backend", choices=["packed", "dense"], default="packed")
+    ap.add_argument("--backend", choices=["packed", "dense", "pallas"], default="packed")
     ap.add_argument("--rule", default="B3/S23")
     args = ap.parse_args()
 
     import jax
+
+    from gameoflifewithactors_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import jax.numpy as jnp
 
     from gameoflifewithactors_tpu.models.rules import parse_rule
     from gameoflifewithactors_tpu.ops import bitpack
     from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.pallas_stencil import (
+        default_interpret,
+        multi_step_pallas,
+    )
     from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
 
     platform = jax.devices()[0].platform
@@ -51,12 +59,18 @@ def main() -> None:
     if args.backend == "packed":
         state = bitpack.pack(jnp.asarray(grid))
         run = lambda s, n: multi_step_packed(s, n, rule=rule, topology=Topology.TORUS)
+    elif args.backend == "pallas":
+        state = bitpack.pack(jnp.asarray(grid))
+        interpret = default_interpret()
+        run = lambda s, n: multi_step_pallas(
+            s, int(n), rule=rule, topology=Topology.TORUS, interpret=interpret)
     else:
         state = jnp.asarray(grid)
         run = lambda s, n: multi_step(s, n, rule=rule, topology=Topology.TORUS)
 
-    # warmup: compile + one generation
-    state = run(state, 1)
+    # warmup: compile + a few generations (>= the pallas temporal depth, so
+    # the kernel itself compiles here, not inside the autotune timing)
+    state = run(state, 10)
     state.block_until_ready()
 
     gens = args.gens

@@ -17,6 +17,9 @@ from .utils.render import ConsoleRenderer
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    from .utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     cfg, args = from_args(argv)
     coordinator, scheduler = cfg.build()
 

@@ -13,6 +13,7 @@ snapshot/population are explicitly asked for.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -23,11 +24,13 @@ from jax.sharding import Mesh
 from .models.rules import Rule, parse_rule
 from .ops import bitpack
 from .ops.packed import multi_step_packed
+from .ops import pallas_stencil
+from .ops.pallas_stencil import multi_step_pallas
 from .ops.stencil import Topology, multi_step
 from .parallel import mesh as mesh_lib
 from .parallel import sharded
 
-BACKENDS = ("packed", "dense")
+BACKENDS = ("packed", "dense", "pallas")
 
 
 class Engine:
@@ -39,8 +42,10 @@ class Engine:
     rule: a Rule or rule string ("B3/S23", "highlife", ...).
     topology: TORUS (wrap) or DEAD (all-dead boundary).
     mesh: optional jax Mesh for 2D sharding; None = single device.
-    backend: "packed" (32 cells/word SWAR, the fast path) or "dense"
-        (1 byte/cell, debug path).
+    backend: "packed" (32 cells/word SWAR, the default fast path), "dense"
+        (1 byte/cell, debug path), or "pallas" (temporal-blocked Mosaic
+        kernel advancing several generations per HBM round-trip;
+        single-device only — the sharded engines use the packed path).
     """
 
     def __init__(
@@ -64,19 +69,25 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
+        self._packed = backend in ("packed", "pallas")
         if mesh is not None:
+            if backend == "pallas":
+                raise ValueError(
+                    "backend='pallas' is single-device; use backend='packed' "
+                    "with a mesh (the sharded SWAR path)"
+                )
             # validate in *cell* units before packing, so the error names the
             # user's grid shape, not the packed word shape
             nx = mesh.shape[mesh_lib.ROW_AXIS]
             ny = mesh.shape[mesh_lib.COL_AXIS]
-            wq = bitpack.WORD * ny if backend == "packed" else ny
+            wq = bitpack.WORD * ny if self._packed else ny
             if self.shape[0] % nx or self.shape[1] % wq:
                 raise ValueError(
                     f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
                     f"need height % {nx} == 0 and width % {wq} == 0"
                     + (" (packed backend shards 32-cell words)" if backend == "packed" else "")
                 )
-        state = bitpack.pack(grid) if backend == "packed" else grid
+        state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
             make = (
@@ -85,15 +96,31 @@ class Engine:
                 else sharded.make_multi_step_dense
             )
             self._run = make(mesh, self.rule, topology)
-        else:
-            if backend == "packed":
+        elif backend == "pallas":
+            # native Mosaic on TPU; interpret mode elsewhere (CPU tests)
+            interpret = pallas_stencil.default_interpret()
+            if not pallas_stencil.supported(state.shape, on_tpu=not interpret):
+                warnings.warn(
+                    f"pallas backend needs width % 4096 == 0 on TPU (got "
+                    f"{self.shape[1]}); falling back to the XLA packed path",
+                    stacklevel=3,
+                )
                 self._run = lambda s, n: multi_step_packed(
                     s, n, rule=self.rule, topology=self.topology
                 )
             else:
-                self._run = lambda s, n: multi_step(
-                    s, n, rule=self.rule, topology=self.topology
+                self._run = lambda s, n: multi_step_pallas(
+                    s, int(n), rule=self.rule, topology=self.topology,
+                    interpret=interpret,
                 )
+        elif backend == "packed":
+            self._run = lambda s, n: multi_step_packed(
+                s, n, rule=self.rule, topology=self.topology
+            )
+        else:
+            self._run = lambda s, n: multi_step(
+                s, n, rule=self.rule, topology=self.topology
+            )
         self._state = state
 
     # -- stepping ------------------------------------------------------------
@@ -121,16 +148,14 @@ class Engine:
         """The full grid as host uint8 (H, W); optionally block-max downsampled
         *on device* to fit within ``max_shape`` before transfer, so rendering
         a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
-        dense = (
-            bitpack.unpack(self._state) if self.backend == "packed" else self._state
-        )
+        dense = bitpack.unpack(self._state) if self._packed else self._state
         if max_shape is not None:
             dense = _downsample_max(dense, max_shape)
         return np.asarray(dense)
 
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total)."""
-        if self.backend == "packed":
+        if self._packed:
             return bitpack.population(self._state)
         return int(np.asarray(jnp.sum(self._state, axis=-1, dtype=jnp.uint32)).sum())
 
@@ -140,7 +165,7 @@ class Engine:
         grid = jnp.asarray(np.asarray(grid, dtype=np.uint8))
         if tuple(grid.shape) != self.shape:
             raise ValueError(f"grid shape {grid.shape} != engine shape {self.shape}")
-        state = bitpack.pack(grid) if self.backend == "packed" else grid
+        state = bitpack.pack(grid) if self._packed else grid
         if self.mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, self.mesh)
         self._state = state

@@ -103,7 +103,7 @@ def _row_triplet(p: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array
     return north, p, south
 
 
-def _horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(west, center, east) planes of a row-aligned slab, with cross-word
     carries; word columns wrap for TORUS and see zeros for DEAD."""
     if topology is Topology.TORUS:
@@ -120,7 +120,7 @@ def neighbor_planes(p: jax.Array, topology: Topology) -> List[jax.Array]:
     """The 8 Moore-neighbor indicator planes of a packed grid."""
     planes: List[jax.Array] = []
     for dv, slab in zip((-1, 0, 1), _row_triplet(p, topology)):
-        w, c, e = _horizontal_planes(slab, topology)
+        w, c, e = horizontal_planes(slab, topology)
         planes.extend([w, e] if dv == 0 else [w, c, e])
     return planes
 

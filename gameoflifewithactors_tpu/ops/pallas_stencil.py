@@ -1,0 +1,206 @@
+"""Temporally-blocked Pallas (Mosaic) stencil kernel — the native fast path.
+
+The reference has no native components to mirror (SURVEY.md §3: it is pure
+managed .NET); the framework's native-code budget goes here instead, per
+SURVEY.md §8 stage 4. The XLA SWAR path (ops/packed.py) is already
+memory-bound at ~2 HBM touches per generation; this kernel beats that bound
+with *temporal blocking*: each grid-row block DMAs ``bh + 2g`` packed rows
+from HBM into VMEM, advances **g generations entirely on-chip** (the slab
+shrinks by 2 rows per generation; the middle ``bh`` rows remain exact), and
+writes back once — HBM traffic per generation drops by ~g×.
+
+Layout/contract:
+- packed uint32 grid (H, W/32), same bit layout as ops/bitpack.py;
+- vertical halos come via 3 contiguous async DMAs (top-wrap, body,
+  bottom-wrap — the wrap segments are contiguous because g <= bh and
+  H % bh == 0); horizontal wrap is in-VMEM word rolls, so the full row
+  width must live in one block (Wp fits VMEM for grids up to ~1M columns);
+- TORUS is handled by the wrapped DMAs; DEAD re-zeroes the exterior rows
+  of boundary blocks before every in-slab generation (exterior cells are
+  *permanently* dead — they must not evolve with the slab);
+- the stencil math itself is imported from ops/packed.py, so Pallas, XLA,
+  and sharded paths share one set of plane/CSA/rule code.
+
+TPU tiling wants the lane (last) dimension a multiple of 128 words (4096
+cells); ``supported()`` gates that, and callers fall back to the XLA path.
+Tests run the kernel in interpret mode on CPU against step_packed.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.rules import Rule
+from .stencil import Topology
+from .packed import apply_rule_planes, bit_sliced_sum, horizontal_planes, multi_step_packed
+
+DEFAULT_BLOCK_ROWS = 256
+DEFAULT_GENS_PER_CALL = 8
+
+
+def step_rows(slab: jax.Array, rule: Rule, topology: Topology) -> jax.Array:
+    """One generation for the interior rows of a (L, Wp) slab -> (L-2, Wp).
+
+    Rows shrink (vertical halos consumed); columns use the grid's own
+    topology since the slab spans the full width.
+    """
+    h = slab.shape[0] - 2
+    planes = []
+    alive = None
+    for dv in (0, 1, 2):
+        s = jax.lax.slice_in_dim(slab, dv, dv + h, axis=0)
+        w, c, e = horizontal_planes(s, topology)
+        if dv == 1:
+            alive = c
+            planes.extend([w, e])
+        else:
+            planes.extend([w, c, e])
+    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)
+
+
+def _zero_exterior(slab, block_idx, n_blocks, halo, topology):
+    """For DEAD topology, force rows outside the global grid back to dead
+    (they must not evolve with the slab). ``halo`` = rows of exterior still
+    present on each side at this point in the in-block generation loop."""
+    if topology is not Topology.DEAD or halo <= 0:
+        return slab
+    L = slab.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 0)
+    top_ext = (block_idx == 0) & (rows < halo)
+    bot_ext = (block_idx == n_blocks - 1) & (rows >= L - halo)
+    return jnp.where(top_ext | bot_ext, jnp.uint32(0), slab)
+
+
+def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int, g: int):
+    n_blocks = H // bh
+    L = bh + 2 * g
+
+    def kernel(p_hbm, out_ref, slab_ref, sems):
+        i = pl.program_id(0)
+        base = i * bh
+        # 3 contiguous segments (wrap segments are contiguous since g <= bh):
+        top = jnp.where(i == 0, H - g, base - g)
+        bot = jnp.where(i == n_blocks - 1, 0, base + bh)
+        d_top = pltpu.make_async_copy(
+            p_hbm.at[pl.ds(top, g)], slab_ref.at[pl.ds(0, g)], sems.at[0])
+        d_mid = pltpu.make_async_copy(
+            p_hbm.at[pl.ds(base, bh)], slab_ref.at[pl.ds(g, bh)], sems.at[1])
+        d_bot = pltpu.make_async_copy(
+            p_hbm.at[pl.ds(bot, g)], slab_ref.at[pl.ds(g + bh, g)], sems.at[2])
+        d_top.start()
+        d_mid.start()
+        d_bot.start()
+        d_top.wait()
+        d_mid.wait()
+        d_bot.wait()
+
+        slab = slab_ref[:]
+        for k in range(g):
+            slab = _zero_exterior(slab, i, n_blocks, g - k, topology)
+            slab = step_rows(slab, rule, topology)
+        out_ref[:] = slab
+
+    return kernel, n_blocks, L
+
+
+def supported(shape, *, on_tpu: bool) -> bool:
+    """Whether the kernel can run this packed (H, Wp) shape natively.
+
+    The TPU lane (last) dimension must be a multiple of 128 words (= 4096
+    cells of width); interpret mode (CPU) has no constraint.
+    """
+    _, Wp = shape
+    return not on_tpu or Wp % 128 == 0
+
+
+def default_interpret() -> bool:
+    """Native Mosaic only exists on TPU; everywhere else use interpret."""
+    return jax.devices()[0].platform != "tpu"
+
+
+def _pick_bh(H: int) -> int:
+    bh = min(DEFAULT_BLOCK_ROWS, H)
+    while H % bh:
+        bh -= 1
+    return bh
+
+
+@lru_cache(maxsize=64)
+def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int, interpret: bool):
+    """Compile-once cache: (kernel pallas_call, jitted chunk loop).
+
+    Keyed on everything that shapes the lowered kernel, so Engine.step /
+    bench repetitions reuse one executable instead of re-tracing per call.
+    """
+    H, Wp = shape
+    kernel, n_blocks, L = _make_kernel(rule, topology, H, Wp, bh, g)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((H, Wp), jnp.uint32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((L, Wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )
+    loop = jax.jit(
+        lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        donate_argnums=0,
+    )
+    return loop
+
+
+def make_pallas_step(
+    rule: Rule,
+    topology: Topology,
+    shape,
+    *,
+    block_rows: Optional[int] = None,
+    gens_per_call: Optional[int] = None,
+    interpret: bool = False,
+):
+    """The cached (loop, g) pair advancing g generations per kernel call.
+
+    ``gens_per_call`` is the temporal-blocking depth g: bigger g = less HBM
+    traffic per generation but more redundant edge recompute (2g extra rows
+    per block per call). g is clamped to bh so wrap DMAs stay contiguous.
+    """
+    H, Wp = shape
+    bh = block_rows or _pick_bh(H)
+    g = min(gens_per_call or DEFAULT_GENS_PER_CALL, bh)
+    if H % bh:
+        raise ValueError(f"grid height {H} not divisible by block rows {bh}")
+    return _build_runner(rule, topology, (H, Wp), bh, g, interpret), g
+
+
+def multi_step_pallas(
+    p: jax.Array,
+    n: int,
+    *,
+    rule: Rule,
+    topology: Topology = Topology.TORUS,
+    block_rows: Optional[int] = None,
+    gens_per_call: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Advance ``n`` generations via the temporal-blocked kernel, with the
+    n % g remainder handled by the XLA SWAR path. ``n`` is a Python int."""
+    loop, g = make_pallas_step(
+        rule, topology, p.shape,
+        block_rows=block_rows, gens_per_call=gens_per_call, interpret=interpret,
+    )
+    chunks, rem = divmod(int(n), g)
+    if chunks:
+        p = loop(p, chunks)
+    if rem:
+        p = multi_step_packed(p, rem, rule=rule, topology=topology)
+    return p

@@ -1,0 +1,25 @@
+"""Small platform helpers shared by the CLI, bench, and engine entry points."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Apply JAX_PLATFORMS via config: some PJRT plugins (e.g. this image's
+    tunneled TPU) register regardless of the env var, so the env alone
+    cannot steer a process onto CPU; the config update can."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass  # backends already initialized; keep whatever we have
+
+
+def on_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "tpu"

@@ -182,3 +182,14 @@ def test_console_renderer_output():
     assert "gen 1" in text and "pop 4" in text
     with pytest.raises(ValueError):
         ConsoleRenderer(out, charset="###")
+
+
+def test_engine_pallas_backend():
+    g = seeds.seeded((32, 64), "glider", 2, 2)
+    e = Engine(g, "conway", backend="pallas")
+    e.step(8)
+    np.testing.assert_array_equal(e.snapshot(), np.roll(g, (2, 2), (0, 1)))
+    assert e.population() == 5
+    with pytest.raises(ValueError, match="single-device"):
+        Engine(np.zeros((16, 256), np.uint8), "conway", backend="pallas",
+               mesh=mesh_lib.make_mesh((2, 4)))

@@ -1,0 +1,107 @@
+"""Pallas temporal-blocked kernel vs the XLA SWAR path (interpret mode).
+
+The kernel must be bit-identical to step_packed across topologies, rules,
+block sizes, and temporal depths — including g spanning block boundaries
+and DEAD-boundary exterior re-zeroing (the subtle one: exterior rows must
+not evolve with the slab).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.models.rules import CONWAY, DAY_AND_NIGHT, HIGHLIFE
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+from gameoflifewithactors_tpu.ops.pallas_stencil import multi_step_pallas, step_rows
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def _random_packed(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return bitpack.pack(jnp.asarray(rng.integers(0, 2, size=(h, w), dtype=np.uint8)))
+
+
+def test_step_rows_matches_packed_interior():
+    """The slab primitive alone: interior rows of one generation."""
+    want = multi_step_packed(_random_packed(24, 128), 1, rule=CONWAY, topology=Topology.TORUS)
+    got = step_rows(_random_packed(24, 128), CONWAY, Topology.TORUS)  # rows 1..22
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want)[1:-1])
+
+
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+@pytest.mark.parametrize("rule", [CONWAY, HIGHLIFE, DAY_AND_NIGHT], ids=str)
+def test_pallas_bit_identity(rule, topology):
+    p = _random_packed(64, 96, seed=7)
+    want = multi_step_packed(p, 12, rule=rule, topology=topology)
+    got = multi_step_pallas(
+        _random_packed(64, 96, seed=7), 12,
+        rule=rule, topology=topology,
+        block_rows=16, gens_per_call=4, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(got)), np.asarray(bitpack.unpack(want))
+    )
+
+
+@pytest.mark.parametrize("bh,g", [(8, 8), (16, 5), (32, 1), (64, 8)])
+def test_pallas_block_and_depth_sweep(bh, g):
+    """g == bh (max temporal depth), non-divisor g, single-block grids."""
+    p = _random_packed(64, 64, seed=3)
+    want = multi_step_packed(p, 11, rule=CONWAY, topology=Topology.TORUS)
+    got = multi_step_pallas(
+        _random_packed(64, 64, seed=3), 11,
+        rule=CONWAY, topology=Topology.TORUS,
+        block_rows=bh, gens_per_call=g, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_dead_boundary_exterior_stays_dead():
+    """Life hugging the global top/bottom edges with DEAD topology — the
+    exterior-re-zero logic is what keeps edge blocks exact."""
+    g = seeds.empty((32, 64))
+    g[0, :20] = 1   # a line on the very first row
+    g[-1, 30:50] = 1
+    p = bitpack.pack(jnp.asarray(g))
+    want = multi_step_packed(p, 10, rule=CONWAY, topology=Topology.DEAD)
+    got = multi_step_pallas(
+        bitpack.pack(jnp.asarray(g)), 10,
+        rule=CONWAY, topology=Topology.DEAD,
+        block_rows=8, gens_per_call=4, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_supported_gate():
+    from gameoflifewithactors_tpu.ops.pallas_stencil import supported
+
+    assert not supported((64, 2), on_tpu=True)     # 64-cell width: no native
+    assert supported((64, 2), on_tpu=False)        # interpret: fine
+    assert supported((16384, 512), on_tpu=True)    # 16384^2: native
+
+
+def test_runner_compile_cache_reused():
+    from gameoflifewithactors_tpu.ops.pallas_stencil import _build_runner
+
+    _build_runner.cache_clear()
+    p = _random_packed(32, 64, seed=1)
+    multi_step_pallas(p, 8, rule=CONWAY, topology=Topology.TORUS,
+                      block_rows=16, gens_per_call=4, interpret=True)
+    p2 = _random_packed(32, 64, seed=2)
+    multi_step_pallas(p2, 8, rule=CONWAY, topology=Topology.TORUS,
+                      block_rows=16, gens_per_call=4, interpret=True)
+    info = _build_runner.cache_info()
+    assert info.misses == 1 and info.hits >= 1
+
+
+def test_pallas_glider_long_run():
+    g = seeds.seeded((48, 96), "glider", 2, 2)
+    p = bitpack.pack(jnp.asarray(g))
+    got = multi_step_pallas(p, 48, rule=CONWAY, topology=Topology.TORUS,
+                            block_rows=16, gens_per_call=6, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(got)),
+        np.roll(g, (12, 12), (0, 1)),
+    )
