@@ -29,7 +29,7 @@ class SimulationConfig:
     random_fill: Optional[float] = None     # Bernoulli p (overrides seed)
     seed_origin: Optional[Tuple[int, int]] = None
     rng_seed: int = 0
-    backend: str = "packed"                 # packed | dense | pallas
+    backend: str = "packed"                 # packed | dense | pallas | sparse
     mesh: Optional[str] = None              # None | "auto" | "2x4"
     steps: int = 100
     render_every: int = 1
@@ -139,7 +139,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed-at", type=_parse_geometry, default=None, metavar="RxC",
                    help="pattern top-left placement (default: centered)")
     p.add_argument("--rng-seed", type=int, default=0)
-    p.add_argument("--backend", choices=["packed", "dense", "pallas"], default="packed")
+    p.add_argument("--backend", choices=["packed", "dense", "pallas", "sparse"], default="packed")
     p.add_argument("--mesh", default=None,
                    help="'auto' (all devices) or 'NXxNY'; default single-device")
     p.add_argument("--steps", type=int, default=100)

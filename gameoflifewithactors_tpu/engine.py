@@ -30,7 +30,7 @@ from .ops.stencil import Topology, multi_step
 from .parallel import mesh as mesh_lib
 from .parallel import sharded
 
-BACKENDS = ("packed", "dense", "pallas")
+BACKENDS = ("packed", "dense", "pallas", "sparse")
 
 
 class Engine:
@@ -43,9 +43,11 @@ class Engine:
     topology: TORUS (wrap) or DEAD (all-dead boundary).
     mesh: optional jax Mesh for 2D sharding; None = single device.
     backend: "packed" (32 cells/word SWAR, the default fast path), "dense"
-        (1 byte/cell, debug path), or "pallas" (temporal-blocked Mosaic
+        (1 byte/cell, debug path), "pallas" (temporal-blocked Mosaic
         kernel advancing several generations per HBM round-trip;
-        single-device only — the sharded engines use the packed path).
+        single-device only — the sharded engines use the packed path), or
+        "sparse" (activity-tiled: compute scales with changed area, for
+        huge mostly-empty universes; single-device, DEAD topology only).
     """
 
     def __init__(
@@ -56,6 +58,7 @@ class Engine:
         topology: Topology = Topology.TORUS,
         mesh: Optional[Mesh] = None,
         backend: str = "packed",
+        sparse_opts: Optional[dict] = None,
     ):
         if backend not in BACKENDS:
             raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
@@ -69,11 +72,17 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
-        self._packed = backend in ("packed", "pallas")
+        self._packed = backend in ("packed", "pallas", "sparse")
+        self._sparse = None
+        if backend == "sparse" and topology is not Topology.DEAD:
+            raise ValueError(
+                "backend='sparse' supports Topology.DEAD only (its zero ring "
+                "is the boundary); use 'packed' for torus grids"
+            )
         if mesh is not None:
-            if backend == "pallas":
+            if backend in ("pallas", "sparse"):
                 raise ValueError(
-                    "backend='pallas' is single-device; use backend='packed' "
+                    f"backend={backend!r} is single-device; use backend='packed' "
                     "with a mesh (the sharded SWAR path)"
                 )
             # validate in *cell* units before packing, so the error names the
@@ -96,6 +105,21 @@ class Engine:
                 else sharded.make_multi_step_dense
             )
             self._run = make(mesh, self.rule, topology)
+        elif backend == "sparse":
+            from .ops.sparse import SparseEngineState
+
+            opts = dict(sparse_opts or {})
+            tr = opts.get("tile_rows", 32)
+            tw = opts.get("tile_words", 4)
+            if self.shape[0] % tr or self.shape[1] % (bitpack.WORD * tw):
+                raise ValueError(
+                    f"grid {self.shape} not divisible into sparse tiles of "
+                    f"{tr} x {bitpack.WORD * tw} cells; pass sparse_opts="
+                    f"dict(tile_rows=..., tile_words=...) that divide it"
+                )
+            self._sparse = SparseEngineState(state, self.rule, **opts)
+            self._run = None  # step() routes through the sparse state
+            state = None  # the padded copy inside _sparse is the state now
         elif backend == "pallas":
             # native Mosaic on TPU; interpret mode elsewhere (CPU tests)
             interpret = pallas_stencil.default_interpret()
@@ -131,24 +155,32 @@ class Engine:
             raise ValueError(f"cannot step a negative number of generations: {n}")
         if n == 0:
             return
-        self._state = self._run(self._state, n)
+        if self._sparse is not None:
+            self._sparse.step(n)
+        else:
+            self._state = self._run(self._state, n)
         self.generation += n
 
     def block_until_ready(self) -> None:
-        self._state.block_until_ready()
+        if self._sparse is not None:
+            self._sparse.padded.block_until_ready()  # no interior-slice copy
+        else:
+            self._state.block_until_ready()
 
     # -- observation ---------------------------------------------------------
 
     @property
     def state(self) -> jax.Array:
         """The raw device array (packed words or uint8 cells)."""
+        if self._sparse is not None:
+            return self._sparse.packed
         return self._state
 
     def snapshot(self, max_shape: Optional[Tuple[int, int]] = None) -> np.ndarray:
         """The full grid as host uint8 (H, W); optionally block-max downsampled
         *on device* to fit within ``max_shape`` before transfer, so rendering
         a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
-        dense = bitpack.unpack(self._state) if self._packed else self._state
+        dense = bitpack.unpack(self.state) if self._packed else self.state
         if max_shape is not None:
             dense = _downsample_max(dense, max_shape)
         return np.asarray(dense)
@@ -156,7 +188,7 @@ class Engine:
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total)."""
         if self._packed:
-            return bitpack.population(self._state)
+            return bitpack.population(self.state)
         return int(np.asarray(jnp.sum(self._state, axis=-1, dtype=jnp.uint32)).sum())
 
     # -- state injection (checkpoint restore, pattern editing) ---------------
@@ -168,7 +200,17 @@ class Engine:
         state = bitpack.pack(grid) if self._packed else grid
         if self.mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, self.mesh)
-        self._state = state
+        if self._sparse is not None:
+            from .ops.sparse import SparseEngineState
+
+            self._sparse = SparseEngineState(
+                state, self.rule,
+                tile_rows=self._sparse.tile_rows,
+                tile_words=self._sparse.tile_words,
+                capacity=self._sparse.capacity,
+            )
+        else:
+            self._state = state
         if generation is not None:
             self.generation = generation
 

@@ -1,0 +1,173 @@
+"""Activity-tiled sparse stepping: compute ∝ active area, not grid area.
+
+BASELINE.json config #5 is a Gosper gun in a 65536² field — ~10² live
+tiles out of ~10⁵. A dense step pays the whole grid every generation; this
+engine keeps a per-tile *changed-last-generation* flag and steps only tiles
+whose 3×3 tile-neighborhood changed (GoL locality makes that exact: a cell
+can only change if something within distance 1 changed, so a tile can only
+change if it or a neighbor tile changed). Still lifes fall asleep; ships
+wake tiles as they travel.
+
+XLA-friendly by construction (SURVEY.md §8 stage 6: "per-tile activity
+flags … rather than a true sparse format, which stays XLA-friendly"):
+
+- state is the packed grid *with a one-word/one-row zero ring* (the DEAD
+  boundary is the ring itself, so edge tiles need no special-casing);
+- each generation gathers a **static capacity** of K candidate tiles with
+  ``jnp.nonzero(..., size=K)`` (static shapes: no recompilation), steps
+  them as a vmapped batch of (T+2-row, Tw+2-word) windows, and scatters
+  the interiors back;
+- if more than K tiles are active, the generation falls back to a full
+  dense step under ``lax.cond`` — correctness never depends on K.
+
+v1 is single-device and DEAD-topology (the zero ring *is* the boundary);
+a torus needs ring maintenance and is left to the dense/sharded paths.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.rules import Rule
+from .packed import step_packed_ext
+from .stencil import Topology
+
+
+def _tile_grid_shape(H: int, Wp: int, tile_rows: int, tile_words: int) -> Tuple[int, int]:
+    if H % tile_rows or Wp % tile_words:
+        raise ValueError(
+            f"packed grid ({H}, {Wp}) not divisible into ({tile_rows}, {tile_words}) tiles"
+        )
+    return H // tile_rows, Wp // tile_words
+
+
+def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int) -> jax.Array:
+    """All tiles containing any live cell are initially 'changed'."""
+    interior = padded[1:-1, 1:-1]
+    H, Wp = interior.shape
+    nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+    tiles = interior.reshape(nty, tile_rows, ntx, tile_words)
+    return (tiles != 0).any(axis=(1, 3))
+
+
+def _dilate(active: jax.Array) -> jax.Array:
+    """3×3 tile-neighborhood OR — which tiles must be stepped."""
+    a = active
+    a = a | jnp.pad(active, ((1, 0), (0, 0)))[:-1, :] | jnp.pad(active, ((0, 1), (0, 0)))[1:, :]
+    a = a | jnp.pad(a, ((0, 0), (1, 0)))[:, :-1] | jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
+    return a
+
+
+@lru_cache(maxsize=32)
+def _build_sparse_step(
+    rule: Rule,
+    shape: Tuple[int, int],
+    tile_rows: int,
+    tile_words: int,
+    capacity: int,
+):
+    """Jitted (padded, active) -> (padded, active) one-generation step."""
+    H, Wp = shape
+    nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+
+    def gather_window(padded, ty, tx):
+        # window = tile + 1 halo ring; padded grid offset makes this exact
+        return jax.lax.dynamic_slice(
+            padded, (ty * tile_rows, tx * tile_words),
+            (tile_rows + 2, tile_words + 2),
+        )
+
+    def sparse_path(padded, candidates):
+        idx = jnp.nonzero(candidates.ravel(), size=capacity, fill_value=0)[0]
+        valid = jnp.arange(capacity) < jnp.sum(candidates)
+        tys, txs = idx // ntx, idx % ntx
+        windows = jax.vmap(lambda ty, tx: gather_window(padded, ty, tx))(tys, txs)
+        stepped = jax.vmap(lambda w: step_packed_ext(w, rule))(windows)
+        olds = windows[:, 1:-1, 1:-1]
+        changed_any = jnp.logical_and((stepped != olds).any(axis=(1, 2)), valid)
+
+        def scatter_one(k, carry):
+            # invalid (fill) slots alias tile 0 and must not touch state —
+            # writing where(valid, ...) would clobber a real tile's fresh
+            # content with its gathered-old copy
+            def do(carry):
+                padded_c, active_c = carry
+                ty, tx = tys[k], txs[k]
+                padded_c = jax.lax.dynamic_update_slice(
+                    padded_c, stepped[k], (ty * tile_rows + 1, tx * tile_words + 1)
+                )
+                return padded_c, active_c.at[ty, tx].set(changed_any[k])
+
+            return jax.lax.cond(valid[k], do, lambda c: c, carry)
+
+        active0 = jnp.zeros((nty, ntx), dtype=bool)
+        padded, active = jax.lax.fori_loop(
+            0, capacity, scatter_one, (padded, active0)
+        )
+        return padded, active
+
+    def dense_path(padded, _candidates):
+        old = padded[1:-1, 1:-1]
+        # the zero ring is the DEAD boundary: step the interior against it
+        new = step_packed_ext(padded, rule)
+        padded = jax.lax.dynamic_update_slice(padded, new, (1, 1))
+        tiles_old = old.reshape(nty, tile_rows, ntx, tile_words)
+        tiles_new = new.reshape(nty, tile_rows, ntx, tile_words)
+        return padded, (tiles_old != tiles_new).any(axis=(1, 3))
+
+    @jax.jit
+    def step(padded, active):
+        candidates = _dilate(active)
+        n_cand = jnp.sum(candidates)
+        return jax.lax.cond(
+            n_cand <= capacity, sparse_path, dense_path, padded, candidates
+        )
+
+    return step
+
+
+class SparseEngineState:
+    """Host-side wrapper holding (padded grid, activity map)."""
+
+    def __init__(
+        self,
+        packed: jax.Array,
+        rule: Rule,
+        *,
+        tile_rows: int = 32,
+        tile_words: int = 4,
+        capacity: int = 256,
+    ):
+        H, Wp = packed.shape
+        _tile_grid_shape(H, Wp, tile_rows, tile_words)  # validate
+        if 0 in rule.born:
+            raise ValueError(
+                f"sparse backend cannot run B0 rules ({rule.notation}): every "
+                "quiescent region births cells each generation, so nothing "
+                "ever sleeps — use the packed backend"
+            )
+        self.rule = rule
+        self.tile_rows = tile_rows
+        self.tile_words = tile_words
+        self.capacity = capacity
+        self.shape = (H, Wp)
+        self.padded = jnp.pad(packed, 1)
+        self.active = initial_activity(self.padded, tile_rows, tile_words)
+        self._step = _build_sparse_step(
+            rule, (H, Wp), tile_rows, tile_words, capacity
+        )
+
+    def step(self, n: int = 1) -> None:
+        for _ in range(n):
+            self.padded, self.active = self._step(self.padded, self.active)
+
+    @property
+    def packed(self) -> jax.Array:
+        return self.padded[1:-1, 1:-1]
+
+    def active_tiles(self) -> int:
+        return int(jnp.sum(self.active))

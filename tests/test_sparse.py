@@ -1,0 +1,101 @@
+"""Activity-tiled sparse engine: exactness, sleep/wake, capacity fallback."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models import seeds
+from gameoflifewithactors_tpu.models.rules import CONWAY
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def _dense_reference(grid, n):
+    p = bitpack.pack(jnp.asarray(grid))
+    return np.asarray(
+        bitpack.unpack(multi_step_packed(p, n, rule=CONWAY, topology=Topology.DEAD))
+    )
+
+
+def _sparse(grid, n, **kw):
+    s = SparseEngineState(bitpack.pack(jnp.asarray(grid)), CONWAY, **kw)
+    s.step(n)
+    return np.asarray(bitpack.unpack(s.packed)), s
+
+
+def test_sparse_matches_dense_glider():
+    g = seeds.seeded((128, 256), "glider", 4, 4)
+    got, s = _sparse(g, 40, tile_rows=16, tile_words=2, capacity=16)
+    np.testing.assert_array_equal(got, _dense_reference(g, 40))
+    # a lone glider keeps only a handful of tiles awake
+    assert s.active_tiles() <= 4
+
+
+def test_sparse_still_life_sleeps():
+    g = seeds.seeded((64, 128), "block", 16, 32)
+    got, s = _sparse(g, 5, tile_rows=16, tile_words=1, capacity=8)
+    np.testing.assert_array_equal(got, _dense_reference(g, 5))
+    assert s.active_tiles() == 0  # still life: everything asleep
+
+
+def test_sparse_gun_matches_dense():
+    g = seeds.seeded((128, 256), "gosper_gun", 8, 8)
+    got, s = _sparse(g, 60, tile_rows=16, tile_words=2, capacity=32)
+    np.testing.assert_array_equal(got, _dense_reference(g, 60))
+    assert got.sum() == 36 + 2 * 5  # gun + 2 gliders at gen 60
+
+
+def test_sparse_capacity_overflow_falls_back_dense():
+    rng = np.random.default_rng(0)
+    g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)  # soup: all tiles hot
+    got, s = _sparse(g, 6, tile_rows=16, tile_words=1, capacity=2)
+    np.testing.assert_array_equal(got, _dense_reference(g, 6))
+
+
+def test_sparse_wake_across_tiles():
+    """A glider leaving its tile must wake the next tile (dilation)."""
+    g = seeds.seeded((96, 128), "glider", 1, 1)
+    got, s = _sparse(g, 90, tile_rows=16, tile_words=1, capacity=16)
+    np.testing.assert_array_equal(got, _dense_reference(g, 90))
+    assert got.sum() == 5  # glider survived three tile crossings
+
+
+def test_sparse_tile_divisibility_validated():
+    with pytest.raises(ValueError):
+        SparseEngineState(jnp.zeros((30, 4), jnp.uint32), CONWAY,
+                          tile_rows=16, tile_words=1)
+
+
+def test_engine_sparse_backend():
+    from gameoflifewithactors_tpu import Engine
+
+    g = seeds.seeded((128, 128), "glider", 4, 4)
+    e = Engine(g, "conway", backend="sparse", topology=Topology.DEAD)
+    e.step(40)
+    np.testing.assert_array_equal(e.snapshot(), _dense_reference(g, 40))
+    assert e.population() == 5
+    with pytest.raises(ValueError, match="DEAD"):
+        Engine(g, "conway", backend="sparse")  # default torus rejected
+
+
+def test_sparse_rejects_b0_rules():
+    from gameoflifewithactors_tpu.models.rules import parse_rule
+
+    with pytest.raises(ValueError, match="B0"):
+        SparseEngineState(jnp.zeros((32, 4), jnp.uint32), parse_rule("B0/S8"))
+
+
+def test_engine_sparse_opts_and_cell_unit_errors():
+    from gameoflifewithactors_tpu import Engine
+
+    g = seeds.seeded((64, 128), "glider", 4, 4)  # needs non-default tiling
+    e = Engine(g, "conway", backend="sparse", topology=Topology.DEAD,
+               sparse_opts=dict(tile_rows=16, tile_words=1, capacity=16))
+    e.step(4)
+    assert e.population() == 5
+    assert e._state is None  # no dead second copy of the grid
+    with pytest.raises(ValueError, match=r"64, 64"):
+        Engine(np.zeros((64, 64), np.uint8), "conway", backend="sparse",
+               topology=Topology.DEAD)
