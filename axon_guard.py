@@ -1,0 +1,33 @@
+"""Keep the tunneled-TPU PJRT plugin off the import path for CPU-only work.
+
+When the axon tunnel is wedged (an observed recurring state of this image),
+plugin *discovery* hangs ``import jax`` itself — even under
+JAX_PLATFORMS=cpu — so any process that must run CPU-only has to drop
+``/root/.axon_site`` from both ``sys.path`` and the PYTHONPATH it passes to
+children *before* jax is first imported.
+
+This lives at the repo root (not inside ``gameoflifewithactors_tpu``) on
+purpose: importing any module of the package pulls in jax via the package
+``__init__``, which is exactly what callers of this helper cannot afford yet.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_MARKER = ".axon_site"
+
+
+def strip_pythonpath(environ: dict | None = None) -> str:
+    """PYTHONPATH value with axon-plugin entries removed (does not mutate)."""
+    env = os.environ if environ is None else environ
+    return os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and _MARKER not in p)
+
+
+def strip_import_path() -> None:
+    """Drop axon-plugin entries from this process's sys.path and PYTHONPATH."""
+    sys.path[:] = [p for p in sys.path if _MARKER not in p]
+    os.environ["PYTHONPATH"] = strip_pythonpath()

@@ -10,30 +10,48 @@ baselines/ and BASELINE.md, not here: this file times the flagship device
 path only, with the generation loop fully on-device (multi_step_packed) so
 host dispatch and readback are off the measured path, matching SURVEY.md
 §8's "benchmarks measure the stencil, not console I/O".
+
+Two robustness measures for the tunneled TPU ("axon" PJRT plugin):
+
+- ``block_until_ready`` is a **no-op** on the tunnel (it returns before the
+  device work finishes), so every timed section is closed by fetching a
+  scalar reduction of the result — the dependent device->host transfer
+  cannot complete before the generations do.
+- The tunnel is intermittently wedged (calls hang forever). The bench body
+  therefore runs in a watchdog subprocess; on hang or device error it is
+  re-run with JAX_PLATFORMS=cpu so one valid JSON line is always printed.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 NORTH_STAR_TARGET = 1e9  # cell-updates/sec/chip, 16384^2 (BASELINE.json)
+WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", "420"))  # per-child hang limit
 
 
-def main() -> None:
+def _parse(argv):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--size", type=int, default=None,
                     help="grid side length (default: 16384 on TPU, 4096 on CPU)")
     ap.add_argument("--gens", type=int, default=None,
                     help="generations per timed repetition (default: autotuned)")
     ap.add_argument("--repeats", type=int, default=3)
-    ap.add_argument("--backend", choices=["packed", "dense", "pallas", "sparse"], default="packed")
+    ap.add_argument("--backend", choices=["packed", "dense", "pallas", "sparse"],
+                    default="packed")
     ap.add_argument("--rule", default="B3/S23")
-    args = ap.parse_args()
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
 
+
+def run_bench(args) -> None:
     import jax
 
     from gameoflifewithactors_tpu.utils.platform import honor_jax_platforms_env
@@ -54,6 +72,12 @@ def main() -> None:
     side = args.size or (16384 if platform != "cpu" else 4096)
     rule = parse_rule(args.rule)
 
+    def sync(x) -> int:
+        """Force completion: block (a no-op on the tunnel), then fetch a
+        scalar that depends on every word of the result."""
+        x.block_until_ready()
+        return int(jnp.sum(x.astype(jnp.uint32))) & 0xFFFF
+
     rng = np.random.default_rng(0)
     if args.backend == "sparse":
         # config #5's shape: a Gosper gun in a huge empty field (a random
@@ -64,17 +88,17 @@ def main() -> None:
     else:
         grid = rng.integers(0, 2, size=(side, side), dtype=np.uint8)
     if args.backend == "packed":
-        state = bitpack.pack(jnp.asarray(grid))
+        state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
         run = lambda s, n: multi_step_packed(s, n, rule=rule, topology=Topology.TORUS)
     elif args.backend == "pallas":
-        state = bitpack.pack(jnp.asarray(grid))
+        state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
         interpret = default_interpret()
         run = lambda s, n: multi_step_pallas(
             s, int(n), rule=rule, topology=Topology.TORUS, interpret=interpret)
     elif args.backend == "sparse":
         from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
 
-        sparse_state = SparseEngineState(bitpack.pack(jnp.asarray(grid)), rule)
+        sparse_state = SparseEngineState(jnp.asarray(bitpack.pack_np(np.asarray(grid))), rule)
 
         def run(s, n):
             sparse_state.step(int(n))
@@ -88,14 +112,14 @@ def main() -> None:
     # warmup: compile + a few generations (>= the pallas temporal depth, so
     # the kernel itself compiles here, not inside the autotune timing)
     state = run(state, 10)
-    state.block_until_ready()
+    sync(state)
 
     gens = args.gens
     if gens is None:
         # autotune: aim for ~2s per repetition
         t0 = time.perf_counter()
         state = run(state, 10)
-        state.block_until_ready()
+        sync(state)
         per_gen = (time.perf_counter() - t0) / 10
         gens = max(10, min(2000, int(2.0 / max(per_gen, 1e-7))))
 
@@ -104,7 +128,7 @@ def main() -> None:
     for _ in range(args.repeats):
         t0 = time.perf_counter()
         state = run(state, gens)
-        state.block_until_ready()
+        sync(state)
         dt = time.perf_counter() - t0
         best = max(best, cells * gens / dt)
 
@@ -115,6 +139,50 @@ def main() -> None:
         "unit": "cell-updates/sec",
         "vs_baseline": best / NORTH_STAR_TARGET,
     }))
+
+
+def main() -> None:
+    args = _parse(sys.argv[1:])
+    if args.child:
+        run_bench(args)
+        return
+
+    def _partial(stream) -> str:
+        if stream is None:
+            return ""
+        return stream.decode(errors="replace") if isinstance(stream, bytes) else stream
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", *sys.argv[1:]]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=WATCHDOG_S)
+        if r.returncode == 0 and r.stdout.strip():
+            sys.stdout.write(r.stdout)
+            sys.stderr.write(r.stderr)
+            return
+        sys.stderr.write(r.stderr)
+        sys.stderr.write(f"\nbench child failed (rc={r.returncode}); retrying on CPU\n")
+    except subprocess.TimeoutExpired as e:
+        sys.stderr.write(_partial(e.stdout))
+        sys.stderr.write(_partial(e.stderr))
+        sys.stderr.write(f"\nbench child hung >{WATCHDOG_S}s (TPU tunnel wedged?); retrying on CPU\n")
+
+    # when the tunnel is wedged the axon PJRT plugin hangs `import jax`
+    # itself, so the CPU fallback must also drop it from PYTHONPATH
+    import axon_guard
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": axon_guard.strip_pythonpath()}
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=WATCHDOG_S, env=env)
+    except subprocess.TimeoutExpired as e:
+        sys.stderr.write(_partial(e.stdout))
+        sys.stderr.write(_partial(e.stderr))
+        sys.stderr.write(f"\nCPU fallback also exceeded {WATCHDOG_S}s; no measurement\n")
+        raise SystemExit(1)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    if r.returncode != 0:
+        raise SystemExit(r.returncode)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,8 @@ class SimulationConfig:
     seed_origin: Optional[Tuple[int, int]] = None
     rng_seed: int = 0
     backend: str = "packed"                 # packed | dense | pallas | sparse
+    sparse_tile: Optional[Tuple[int, int]] = None   # (rows, cols), cols % 32 == 0
+    sparse_capacity: Optional[int] = None   # max active tiles before dense fallback
     mesh: Optional[str] = None              # None | "auto" | "2x4"
     steps: int = 100
     render_every: int = 1
@@ -70,6 +72,22 @@ class SimulationConfig:
             return metrics_lib.MetricsLogger(metrics_lib.CsvSink(f))
         raise ValueError(f"--metrics must be 'jsonl' or 'csv:PATH', got {self.metrics!r}")
 
+    def build_sparse_opts(self) -> Optional[dict]:
+        from .ops import bitpack
+
+        opts = {}
+        if self.sparse_tile is not None:
+            rows, cols = self.sparse_tile
+            if cols % bitpack.WORD:
+                raise ValueError(
+                    f"--sparse-tile columns must be a multiple of {bitpack.WORD}, got {cols}"
+                )
+            opts["tile_rows"] = rows
+            opts["tile_words"] = cols // bitpack.WORD
+        if self.sparse_capacity is not None:
+            opts["capacity"] = self.sparse_capacity
+        return opts or None
+
     def build(self):
         """Construct the full (coordinator, scheduler) stack."""
         from .coordinator import GridCoordinator
@@ -104,6 +122,7 @@ class SimulationConfig:
                 topology=topology,
                 mesh=mesh,
                 backend=self.backend,
+                sparse_opts=self.build_sparse_opts(),
                 track_population=self.track_population,
                 metrics=self.build_metrics(),
                 view_shape=(self.view_height, self.view_width),
@@ -140,6 +159,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="pattern top-left placement (default: centered)")
     p.add_argument("--rng-seed", type=int, default=0)
     p.add_argument("--backend", choices=["packed", "dense", "pallas", "sparse"], default="packed")
+    p.add_argument("--sparse-tile", type=_parse_geometry, default=None, metavar="RxC",
+                   help="sparse backend tile size in cells; C % 32 == 0 (default 32x128)")
+    p.add_argument("--sparse-capacity", type=int, default=None, metavar="N",
+                   help="sparse backend: max active tiles per step before dense fallback")
     p.add_argument("--mesh", default=None,
                    help="'auto' (all devices) or 'NXxNY'; default single-device")
     p.add_argument("--steps", type=int, default=100)
@@ -173,6 +196,8 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         seed_origin=args.seed_at,
         rng_seed=args.rng_seed,
         backend=args.backend,
+        sparse_tile=args.sparse_tile,
+        sparse_capacity=args.sparse_capacity,
         mesh=args.mesh,
         steps=args.steps,
         render_every=args.render_every,

@@ -65,12 +65,14 @@ class GridCoordinator:
         topology: Topology = Topology.TORUS,
         mesh: Optional[Mesh] = None,
         backend: str = "packed",
+        sparse_opts: Optional[dict] = None,
         track_population: bool = False,
         metrics: Optional[MetricsLogger] = None,
         view_shape: Optional[Tuple[int, int]] = None,
     ):
         grid = self._build_seed(shape, seed, seed_origin, random_fill, rng_seed)
-        engine = Engine(grid, rule, topology=topology, mesh=mesh, backend=backend)
+        engine = Engine(grid, rule, topology=topology, mesh=mesh, backend=backend,
+                        sparse_opts=sparse_opts)
         self._init_from_engine(engine, track_population, metrics, view_shape)
 
     def _init_from_engine(self, engine, track_population, metrics, view_shape) -> None:

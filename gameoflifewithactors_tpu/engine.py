@@ -106,11 +106,15 @@ class Engine:
             )
             self._run = make(mesh, self.rule, topology)
         elif backend == "sparse":
-            from .ops.sparse import SparseEngineState
+            from .ops.sparse import (
+                DEFAULT_TILE_ROWS,
+                DEFAULT_TILE_WORDS,
+                SparseEngineState,
+            )
 
             opts = dict(sparse_opts or {})
-            tr = opts.get("tile_rows", 32)
-            tw = opts.get("tile_words", 4)
+            tr = opts.get("tile_rows", DEFAULT_TILE_ROWS)
+            tw = opts.get("tile_words", DEFAULT_TILE_WORDS)
             if self.shape[0] % tr or self.shape[1] % (bitpack.WORD * tw):
                 raise ValueError(
                     f"grid {self.shape} not divisible into sparse tiles of "

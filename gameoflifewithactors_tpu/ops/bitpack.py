@@ -34,6 +34,22 @@ def pack(state: jax.Array) -> jax.Array:
     return jnp.sum(bits * _BIT_WEIGHTS, axis=-1, dtype=jnp.uint32)
 
 
+def pack_np(state: np.ndarray) -> np.ndarray:
+    """Host-side (H, W) uint8 -> (H, W/32) uint32 pack, same layout as
+    :func:`pack`.
+
+    Packing on the host before `device_put` ships 1 bit/cell instead of
+    1 byte/cell — on a tunneled TPU the 8× smaller transfer matters more
+    than the pack cost itself.
+    """
+    h, w = state.shape
+    wp = packed_width(w)
+    by = np.packbits(np.ascontiguousarray(state, dtype=np.uint8),
+                     axis=-1, bitorder="little")
+    # bytes k..k+3 of a row are bits 0..31 of word k/4 -> little-endian u32
+    return by.reshape(h, wp, 4).view(np.dtype("<u4")).reshape(h, wp)
+
+
 def unpack(packed: jax.Array) -> jax.Array:
     """(H, W/32) uint32 -> (H, W) uint8 in {0,1}."""
     h, wp = packed.shape

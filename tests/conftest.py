@@ -8,6 +8,15 @@ production paths never import this; they see the real TPU.
 """
 
 import os
+import sys
+
+# Drop the tunneled-TPU PJRT plugin from the import path entirely: when the
+# tunnel is wedged (observed repeatedly), plugin discovery hangs `import jax`
+# itself, even under JAX_PLATFORMS=cpu. Tests are CPU-only by design.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import axon_guard  # noqa: E402  (repo-root helper; must not import jax)
+
+axon_guard.strip_import_path()
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")

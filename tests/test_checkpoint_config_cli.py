@@ -76,6 +76,24 @@ def test_config_mesh_parsing():
         SimulationConfig(mesh="banana").build_mesh()
 
 
+def test_config_sparse_opts_plumbing():
+    # regression: --sparse-tile/--sparse-capacity must reach the sparse
+    # engine (grids indivisible by the default 32x128 tile were unusable
+    # from the CLI before sparse_opts was plumbed through the coordinator)
+    cfg, _ = from_args(
+        ["--grid", "48x256", "--topology", "dead", "--backend", "sparse",
+         "--sparse-tile", "16x64", "--sparse-capacity", "64", "--seed", "glider"]
+    )
+    assert cfg.sparse_tile == (16, 64) and cfg.sparse_capacity == 64
+    c, _ = cfg.build()
+    sp = c.engine._sparse
+    assert (sp.tile_rows, sp.tile_words, sp.capacity) == (16, 2, 64)
+    c.tick(8)
+    assert c.population() == 5
+    with pytest.raises(ValueError):
+        SimulationConfig(backend="sparse", sparse_tile=(16, 33)).build_sparse_opts()
+
+
 def test_from_args_roundtrip():
     cfg, args = from_args(
         ["--grid", "128x128", "--rule", "highlife", "--seed", "random",

@@ -25,6 +25,26 @@ def test_pack_rejects_ragged_width():
         bitpack.pack(jnp.zeros((4, 33), dtype=jnp.uint8))
 
 
+@pytest.mark.parametrize("shape", [(1, 32), (10, 64), (37, 96), (64, 1024)])
+def test_pack_np_matches_pack(shape):
+    """bench.py builds every initial state via the host-side pack; it must
+    honor the exact bit-i-of-word-j layout contract of the device pack."""
+    rng = np.random.default_rng(7)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    host = bitpack.pack_np(g)
+    assert host.dtype == np.uint32
+    np.testing.assert_array_equal(host, np.asarray(bitpack.pack(jnp.asarray(g))))
+    np.testing.assert_array_equal(np.asarray(bitpack.unpack(jnp.asarray(host))), g)
+
+
+def test_pack_np_noncontiguous_input():
+    g = np.zeros((8, 128), dtype=np.uint8)
+    g[:, ::3] = 1
+    view = g[::2, 32:96]  # strided, offset view
+    np.testing.assert_array_equal(
+        bitpack.pack_np(view), np.asarray(bitpack.pack(jnp.asarray(np.ascontiguousarray(view)))))
+
+
 def test_population_exact():
     rng = np.random.default_rng(3)
     g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)
