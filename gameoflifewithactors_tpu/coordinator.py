@@ -156,6 +156,7 @@ class GridCoordinator:
                     wall_seconds=dt,
                     cell_updates_per_sec=cells / dt if dt > 0 else float("inf"),
                     population=self.population() if self.track_population else None,
+                    halo_bytes=self.engine.halo_bytes_per_gen() * n or None,
                 )
             )
         self._notify()

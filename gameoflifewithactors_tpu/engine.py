@@ -189,6 +189,27 @@ class Engine:
             dense = _downsample_max(dense, max_shape)
         return np.asarray(dense)
 
+    def halo_bytes_per_gen(self) -> int:
+        """Estimated interconnect (ICI/DCN) bytes one generation moves: the
+        four ppermute strips per device tile (halo.py). 0 when unsharded —
+        the analogue of the reference's ~9·N·M mailbox messages/generation
+        (SURVEY.md §4b) collapsing to 4 strip sends per *tile*."""
+        if self.mesh is None:
+            return 0
+        nx = self.mesh.shape[mesh_lib.ROW_AXIS]
+        ny = self.mesh.shape[mesh_lib.COL_AXIS]
+        h, w = self.shape
+        wq = (w // bitpack.WORD) if self._packed else w
+        itemsize = 4 if self._packed else 1
+        row_strip = (wq // ny) * itemsize          # 1 row of one tile
+        col_strip = (h // nx + 2) * itemsize       # 1 column of a row-extended tile
+        wrap = self.topology is Topology.TORUS
+        # a size-1 axis exchanges nothing over the interconnect (the torus
+        # "send" is a device-local self-copy); DEAD edges drop the wrap send
+        row_sends = 2 * ny * (nx if wrap else nx - 1) if nx > 1 else 0
+        col_sends = 2 * nx * (ny if wrap else ny - 1) if ny > 1 else 0
+        return row_sends * row_strip + col_sends * col_strip
+
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total)."""
         if self._packed:

@@ -34,18 +34,113 @@ def factor2d(n: int) -> Tuple[int, int]:
     return best
 
 
+def factor2d_sliced(n: int, n_slices: int) -> Tuple[int, int]:
+    """Most-square (nx, ny) such that slices can band into whole mesh rows:
+    ny must divide the per-slice device count (so each slice fills complete
+    rows and only N/S halos cross DCN)."""
+    if n % n_slices:
+        raise ValueError(f"{n} devices do not split over {n_slices} slices")
+    per = n // n_slices
+    best = None
+    for ny in range(1, per + 1):
+        if per % ny == 0:
+            nx = n // ny
+            if best is None or abs(nx - ny) <= abs(best[0] - best[1]):
+                best = (nx, ny)  # ties resolve to nx <= ny, like factor2d
+    return best
+
+
+def slice_ids_of(devices: Sequence[jax.Device]) -> list:
+    """Per-device slice index (DCN granule); devices without one (CPU fakes,
+    single-slice TPUs) all report 0."""
+    return [getattr(d, "slice_index", 0) or 0 for d in devices]
+
+
+def order_devices_for_slices(
+    devices: Sequence[jax.Device],
+    shape: Tuple[int, int],
+    slice_ids: Optional[Sequence[int]] = None,
+) -> "np.ndarray":
+    """Arrange devices into an (nx, ny) array so each mesh row holds devices
+    of exactly one slice (slices own contiguous row *bands*).
+
+    This is the multi-slice layout decision: the grid's row axis is cut
+    across slices, so per generation the only traffic that crosses **DCN**
+    is one north + one south halo strip per slice boundary; all other halo
+    exchange (and everything on the column axis) rides **ICI**. The
+    reference has no analogue — its one "interconnect" is the in-process
+    Akka mailbox (SURVEY.md §2) — so this layout rule is the framework's
+    DCN story, and it degrades to a plain reshape when there is one slice.
+    """
+    nx, ny = shape
+    devices = list(devices)
+    ids = list(slice_ids) if slice_ids is not None else slice_ids_of(devices)
+    if len(ids) != len(devices):
+        raise ValueError(f"{len(ids)} slice ids for {len(devices)} devices")
+    groups: dict = {}
+    for d, s in zip(devices, ids):
+        groups.setdefault(s, []).append(d)
+    if len(groups) == 1:
+        return np.asarray(devices).reshape(nx, ny)
+    sizes = {s: len(g) for s, g in groups.items()}
+    per = next(iter(sizes.values()))
+    if any(v != per for v in sizes.values()):
+        raise ValueError(f"uneven devices per slice: {sizes}")
+    rows_per_slice, rem = divmod(per, ny)
+    if rem or rows_per_slice == 0:
+        raise ValueError(
+            f"mesh shape {shape}: each slice's {per} devices must fill whole "
+            f"mesh rows (need {ny} per row) so slice boundaries align with "
+            f"row bands and only N/S halos cross DCN"
+        )
+    if rows_per_slice * len(groups) != nx:
+        raise ValueError(
+            f"mesh shape {shape} incompatible with {len(groups)} slices of {per}"
+        )
+    bands = [
+        np.asarray(groups[s]).reshape(rows_per_slice, ny)
+        for s in sorted(groups)
+    ]
+    return np.vstack(bands)
+
+
 def make_mesh(
     shape: Optional[Tuple[int, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    slice_ids: Optional[Sequence[int]] = None,
 ) -> Mesh:
-    """A 2D mesh with axes (ROW_AXIS, COL_AXIS) over the given devices."""
+    """A 2D mesh with axes (ROW_AXIS, COL_AXIS) over the given devices.
+
+    Multi-slice device sets (distinct ``slice_index``) are laid out so
+    slices form contiguous row bands — see :func:`order_devices_for_slices`.
+    """
+    import warnings
+
     devices = list(devices if devices is not None else jax.devices())
+    ids = list(slice_ids) if slice_ids is not None else slice_ids_of(devices)
+    n_slices = len(set(ids))
     if shape is None:
-        shape = factor2d(len(devices))
+        if n_slices > 1 and len(devices) % n_slices == 0:
+            shape = factor2d_sliced(len(devices), n_slices)
+        else:
+            shape = factor2d(len(devices))
     nx, ny = shape
     if nx * ny != len(devices):
         raise ValueError(f"mesh shape {shape} needs {nx * ny} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices).reshape(nx, ny), (ROW_AXIS, COL_AXIS))
+    try:
+        arr = order_devices_for_slices(devices, (nx, ny), ids)
+    except ValueError as e:
+        if slice_ids is not None:
+            raise  # caller asked for this exact banding; don't paper over it
+        warnings.warn(
+            f"slice-banded layout impossible for mesh {shape} "
+            f"({n_slices} slices): {e}; falling back to unordered layout "
+            "(halo exchange may cross DCN on both axes)",
+            stacklevel=2,
+        )
+        arr = np.asarray(devices).reshape(nx, ny)
+    return Mesh(arr, (ROW_AXIS, COL_AXIS))
 
 
 def grid_sharding(mesh: Mesh) -> NamedSharding:

@@ -24,11 +24,13 @@ class StepMetrics:
     wall_seconds: float
     cell_updates_per_sec: float
     population: Optional[int] = None
+    halo_bytes: Optional[int] = None   # est. interconnect bytes this record
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
-        if self.population is None:
-            d.pop("population")
+        for k in ("population", "halo_bytes"):
+            if d[k] is None:
+                d.pop(k)
         return d
 
 

@@ -1,0 +1,126 @@
+"""Weak-scaling harness: fixed per-device tile, growing device count.
+
+SURVEY.md §8 stage 6 ("weak-scaling harness to v5e-64"). For each device
+count n, a (nx, ny) mesh is built (slice-banded when the devices span DCN
+slices — parallel/mesh.py), the global grid is sized nx·TH × ny·TW so every
+device always steps the same TH×TW tile, and the sharded multi-step runs
+the whole generation loop on-device. Efficiency is rate(n) / (n · rate(1)):
+1.0 means halo exchange is free, which on ICI it nearly is (two row strips
++ two column strips per tile per generation — see Engine.halo_bytes_per_gen).
+
+Prints one JSON line per device count plus a summary line. On this image
+real multi-chip hardware is absent; run under
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
+to exercise the plumbing (all "devices" share one host CPU, so measured
+efficiency there reflects core contention, not the interconnect — the
+number that matters comes from a real slice).
+
+Timing uses the same scalar-readback sync as bench.py: block_until_ready is
+a no-op on the tunneled-TPU platform.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tile", default=None, metavar="HxW",
+                    help="per-device tile in cells (default 4096x4096 TPU, 512x512 CPU)")
+    ap.add_argument("--gens", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--rule", default="B3/S23")
+    ap.add_argument("--counts", default=None,
+                    help="comma-separated device counts (default: 1,2,4,... up to all)")
+    args = ap.parse_args()
+
+    import jax
+
+    from gameoflifewithactors_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.rules import parse_rule
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.parallel import sharded
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if args.tile:
+        th, tw = (int(v) for v in args.tile.split("x"))
+    else:
+        th, tw = (4096, 4096) if platform != "cpu" else (512, 512)
+    if tw % bitpack.WORD:
+        raise SystemExit(f"tile width must be a multiple of {bitpack.WORD}")
+    rule = parse_rule(args.rule)
+
+    if args.counts:
+        counts = [int(c) for c in args.counts.split(",")]
+    else:
+        counts, c = [], 1
+        while c <= len(devices):
+            counts.append(c)
+            c *= 2
+        if counts[-1] != len(devices):
+            counts.append(len(devices))  # non-power-of-two machines
+    counts = [c for c in counts if c <= len(devices)]
+
+    def sync(x) -> None:
+        x.block_until_ready()
+        int(jnp.sum(x.astype(jnp.uint32)))  # dependent fetch: forces completion
+
+    rng = np.random.default_rng(0)
+    base = None  # (devices, rate) of the first measured point
+    results = []
+    for n in counts:
+        nx, ny = mesh_lib.factor2d(n)
+        mesh = mesh_lib.make_mesh((nx, ny), devices[:n])
+        H, W = nx * th, ny * tw
+        grid = rng.integers(0, 2, size=(H, W), dtype=np.uint8)
+        p = mesh_lib.device_put_sharded_grid(
+            jnp.asarray(bitpack.pack_np(grid)), mesh)
+        run = sharded.make_multi_step_packed(mesh, rule, Topology.TORUS)
+        p = run(p, 8)  # compile + warm
+        sync(p)
+        best = 0.0
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            p = run(p, args.gens)
+            sync(p)
+            dt = time.perf_counter() - t0
+            best = max(best, H * W * args.gens / dt)
+        if base is None:
+            base = (n, best)
+        # efficiency is per-device rate vs the baseline's per-device rate,
+        # so a sweep that starts above 1 device still reports 1.0 first
+        eff = (best / n) / (base[1] / base[0])
+        rec = {
+            "devices": n, "mesh": [nx, ny], "grid": [H, W],
+            "cell_updates_per_sec": best,
+            "per_device": best / n,
+            "weak_scaling_efficiency": eff,
+            "platform": platform,
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    print(json.dumps({
+        "metric": f"weak-scaling efficiency, {th}x{tw}/device, {rule.notation} ({platform})",
+        "value": results[-1]["weak_scaling_efficiency"],
+        "unit": "fraction",
+        "devices": results[-1]["devices"],
+    }))
+    return
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,3 +93,42 @@ def test_population_bounds_validator():
     assert fault.population_bounds_validator(1, 100)(e)
     assert not fault.population_bounds_validator(6, None)(e)
     assert not fault.population_bounds_validator(0, 4)(e)
+
+
+def test_halo_bytes_metric():
+    import jax
+
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.utils.metrics import StepMetrics
+
+    # unsharded: no interconnect traffic
+    e1 = Engine(seeds.empty((32, 64)), "B3/S23")
+    assert e1.halo_bytes_per_gen() == 0
+
+    # 2x4 mesh, packed: per tile 2 row strips of (wq/ny) words + 2 col
+    # strips of (h/nx + 2) words, 4 bytes/word, 8 tiles
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    e2 = Engine(seeds.empty((32, 256)), "B3/S23", mesh=m)
+    wq, h = 256 // 32, 32
+    want = 8 * 2 * ((wq // 4) * 4 + (h // 2 + 2) * 4)
+    assert e2.halo_bytes_per_gen() == want
+
+    # a size-1 mesh axis moves nothing over the interconnect (self-copy)
+    m18 = mesh_lib.make_mesh((1, 8), jax.devices())
+    e3 = Engine(seeds.empty((32, 256)), "B3/S23", mesh=m18)
+    col_strip = (32 // 1 + 2) * 4
+    assert e3.halo_bytes_per_gen() == 2 * 1 * 8 * col_strip  # columns only
+
+    # DEAD boundary drops the wrap sends: (nx-1) and (ny-1) per direction
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    e4 = Engine(seeds.empty((32, 256)), "B3/S23", mesh=m, topology=Topology.DEAD)
+    row_strip = (wq // 4) * 4
+    col_strip = (h // 2 + 2) * 4
+    assert e4.halo_bytes_per_gen() == 2 * 4 * 1 * row_strip + 2 * 2 * 3 * col_strip
+
+    # the optional field stays out of records when absent
+    rec = StepMetrics(1, 1, 0.5, 1e6).to_dict()
+    assert "halo_bytes" not in rec and "population" not in rec
+    rec2 = StepMetrics(1, 1, 0.5, 1e6, halo_bytes=128).to_dict()
+    assert rec2["halo_bytes"] == 128

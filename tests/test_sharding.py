@@ -111,3 +111,84 @@ def test_output_stays_sharded():
     p = mesh_lib.device_put_sharded_grid(jnp.zeros((32, 8), jnp.uint32), m)
     out = sharded.make_step_packed(m, CONWAY, Topology.TORUS)(p)
     assert out.sharding == mesh_lib.grid_sharding(m)
+
+
+# -- multi-slice (DCN) layout -------------------------------------------------
+
+def test_multislice_layout_row_bands():
+    """With 2 pretend slices of 4 devices, slices must own contiguous row
+    bands so only N/S halos cross the slice (DCN) boundary."""
+    devs = jax.devices()
+    ids = [0, 0, 0, 0, 1, 1, 1, 1]
+    arr = mesh_lib.order_devices_for_slices(devs, (4, 2), ids)
+    by_dev = dict(zip(devs, ids))
+    for r in range(4):
+        row_slices = {by_dev[d] for d in arr[r]}
+        assert len(row_slices) == 1, f"mesh row {r} spans slices {row_slices}"
+    # band order: slice 0 rows first, then slice 1
+    assert by_dev[arr[0, 0]] == 0 and by_dev[arr[3, 0]] == 1
+
+
+def test_multislice_layout_interleaved_ids():
+    devs = jax.devices()
+    arr = mesh_lib.order_devices_for_slices(devs, (2, 4), [0, 1, 0, 1, 0, 1, 0, 1])
+    ids = dict(zip(devs, [0, 1, 0, 1, 0, 1, 0, 1]))
+    assert {ids[d] for d in arr[0]} == {0}
+    assert {ids[d] for d in arr[1]} == {1}
+
+
+def test_multislice_layout_rejects_bad_shapes():
+    devs = jax.devices()
+    two_slices = [0, 0, 0, 0, 1, 1, 1, 1]
+    with pytest.raises(ValueError):  # slice boundary would cut a mesh row
+        mesh_lib.order_devices_for_slices(devs, (1, 8), two_slices)
+    with pytest.raises(ValueError):  # uneven devices per slice
+        mesh_lib.order_devices_for_slices(devs, (4, 2), [0, 0, 0, 1, 1, 1, 1, 1])
+    with pytest.raises(ValueError):  # id count mismatch
+        mesh_lib.order_devices_for_slices(devs, (4, 2), [0, 1])
+
+
+def test_factor2d_sliced_prefers_slice_compatible_shapes():
+    # 32 devices on 8 slices: plain factor2d gives (4, 8), which cannot band
+    # (4 per slice < 8 per row); the sliced factorization must pick ny | 4
+    assert mesh_lib.factor2d_sliced(32, 8) == (8, 4)
+    assert mesh_lib.factor2d_sliced(8, 2) == (2, 4)  # 1 row per slice band
+    assert mesh_lib.factor2d_sliced(8, 1) == (2, 4)  # degenerates to factor2d
+
+
+def test_make_mesh_default_shape_is_slice_compatible():
+    m = mesh_lib.make_mesh(devices=jax.devices(), slice_ids=[0, 0, 0, 0, 1, 1, 1, 1])
+    assert (m.shape[mesh_lib.ROW_AXIS], m.shape[mesh_lib.COL_AXIS]) == (2, 4)
+
+
+def test_make_mesh_falls_back_when_banding_impossible():
+    # explicit shape (1, 8) cannot band 2 slices into row bands; with
+    # auto-detected ids it must warn and fall back, not crash
+    import warnings as w
+
+    devs = jax.devices()
+    orig = mesh_lib.slice_ids_of
+    mesh_lib.slice_ids_of = lambda ds: [0, 0, 0, 0, 1, 1, 1, 1]
+    try:
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            m = mesh_lib.make_mesh((1, 8), devs)
+        assert any("falling back" in str(c.message) for c in caught)
+        assert m.shape[mesh_lib.COL_AXIS] == 8
+        with pytest.raises(ValueError):  # explicit slice_ids: no fallback
+            mesh_lib.make_mesh((1, 8), devs, slice_ids=[0, 0, 0, 0, 1, 1, 1, 1])
+    finally:
+        mesh_lib.slice_ids_of = orig
+
+
+def test_multislice_mesh_bit_identity():
+    """A slice-banded mesh is just a device reordering: results must be
+    bit-identical to the single-device engine."""
+    m = mesh_lib.make_mesh((4, 2), jax.devices(), slice_ids=[0, 0, 0, 0, 1, 1, 1, 1])
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 2, size=(64, 64), dtype=np.uint8)
+    want = np.asarray(bitpack.unpack(
+        multi_step_packed(bitpack.pack(jnp.asarray(g)), 16, rule=CONWAY, topology=Topology.TORUS)))
+    p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+    run = sharded.make_multi_step_packed(m, CONWAY, Topology.TORUS)
+    np.testing.assert_array_equal(np.asarray(bitpack.unpack(run(p, 16))), want)
