@@ -47,7 +47,9 @@ class Engine:
         kernel advancing several generations per HBM round-trip;
         single-device only — the sharded engines use the packed path), or
         "sparse" (activity-tiled: compute scales with changed area, for
-        huge mostly-empty universes; single-device, DEAD topology only).
+        huge mostly-empty universes; single-device form is DEAD-only,
+        with a mesh it shards with per-device activity skipping and
+        supports both topologies).
     """
 
     def __init__(
@@ -74,15 +76,17 @@ class Engine:
 
         self._packed = backend in ("packed", "pallas", "sparse")
         self._sparse = None
-        if backend == "sparse" and topology is not Topology.DEAD:
+        self._flags = None
+        if backend == "sparse" and mesh is None and topology is not Topology.DEAD:
             raise ValueError(
-                "backend='sparse' supports Topology.DEAD only (its zero ring "
-                "is the boundary); use 'packed' for torus grids"
+                "single-device backend='sparse' supports Topology.DEAD only "
+                "(its zero ring is the boundary); use 'packed' for torus "
+                "grids, or add a mesh (the sharded sparse path handles torus)"
             )
         if mesh is not None:
-            if backend in ("pallas", "sparse"):
+            if backend == "pallas":
                 raise ValueError(
-                    f"backend={backend!r} is single-device; use backend='packed' "
+                    "backend='pallas' is single-device; use backend='packed' "
                     "with a mesh (the sharded SWAR path)"
                 )
             # validate in *cell* units before packing, so the error names the
@@ -94,17 +98,36 @@ class Engine:
                 raise ValueError(
                     f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
                     f"need height % {nx} == 0 and width % {wq} == 0"
-                    + (" (packed backend shards 32-cell words)" if backend == "packed" else "")
+                    + (" (bit-packed backends shard 32-cell words)" if self._packed else "")
                 )
         state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
-            make = (
-                sharded.make_multi_step_packed
-                if backend == "packed"
-                else sharded.make_multi_step_dense
-            )
-            self._run = make(mesh, self.rule, topology)
+            if backend == "sparse":
+                if sparse_opts:
+                    warnings.warn(
+                        "sparse_opts (tile_rows/tile_words/capacity) apply to "
+                        "the single-device sparse engine only; the sharded "
+                        "sparse path skips at per-device granularity and "
+                        "ignores them",
+                        stacklevel=3,
+                    )
+                # per-device activity skipping: flags ride along with state
+                self._flags = sharded.initial_flags(mesh)
+                run2 = sharded.make_multi_step_packed_sparse(mesh, self.rule, topology)
+
+                def _run(s, n):
+                    s, self._flags = run2(s, self._flags, n)
+                    return s
+
+                self._run = _run
+            else:
+                make = (
+                    sharded.make_multi_step_packed
+                    if backend == "packed"
+                    else sharded.make_multi_step_dense
+                )
+                self._run = make(mesh, self.rule, topology)
         elif backend == "sparse":
             from .ops.sparse import (
                 DEFAULT_TILE_ROWS,
@@ -208,7 +231,12 @@ class Engine:
         # "send" is a device-local self-copy); DEAD edges drop the wrap send
         row_sends = 2 * ny * (nx if wrap else nx - 1) if nx > 1 else 0
         col_sends = 2 * nx * (ny if wrap else ny - 1) if ny > 1 else 0
-        return row_sends * row_strip + col_sends * col_strip
+        total = row_sends * row_strip + col_sends * col_strip
+        if self._flags is not None:
+            # sharded sparse also halo-exchanges the (1,1) uint32 activity
+            # flag: 4-byte row strips, 12-byte (3,1) column strips
+            total += row_sends * 4 + col_sends * 12
+        return total
 
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total)."""
@@ -236,6 +264,8 @@ class Engine:
             )
         else:
             self._state = state
+        if self._flags is not None:
+            self._flags = sharded.initial_flags(self.mesh)  # wake every tile
         if generation is not None:
             self.generation = generation
 

@@ -99,3 +99,118 @@ def test_engine_sparse_opts_and_cell_unit_errors():
     with pytest.raises(ValueError, match=r"64, 64"):
         Engine(np.zeros((64, 64), np.uint8), "conway", backend="sparse",
                topology=Topology.DEAD)
+
+
+# -- sharded sparse: per-device activity skipping -----------------------------
+
+class TestShardedSparse:
+    def _mesh(self, shape=(2, 4)):
+        import jax
+
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        return mesh_lib.make_mesh(shape, jax.devices()[: shape[0] * shape[1]])
+
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    def test_bit_identity_random_soup(self, topology):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+        from gameoflifewithactors_tpu.parallel import sharded
+
+        m = self._mesh()
+        rng = np.random.default_rng(9)
+        g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            bitpack.pack(jnp.asarray(g)), 20, rule=CONWAY, topology=topology)))
+        p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+        run = sharded.make_multi_step_packed_sparse(m, CONWAY, topology)
+        out, _ = run(p, sharded.initial_flags(m), 20)
+        np.testing.assert_array_equal(np.asarray(bitpack.unpack(out)), want)
+
+    def test_still_life_puts_all_tiles_to_sleep(self):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models import seeds
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+        from gameoflifewithactors_tpu.parallel import sharded
+
+        m = self._mesh()
+        g = seeds.seeded((64, 128), "block", 10, 10)
+        p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+        run = sharded.make_multi_step_packed_sparse(m, CONWAY, Topology.TORUS)
+        out, flags = run(p, sharded.initial_flags(m), 3)
+        assert np.asarray(flags).sum() == 0, "block is a still life; all asleep"
+        out2, flags2 = run(out, flags, 50)  # sleeping universe stays exact
+        np.testing.assert_array_equal(np.asarray(bitpack.unpack(out2)), g)
+        assert np.asarray(flags2).sum() == 0
+
+    def test_glider_wakes_tiles_as_it_travels(self):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models import seeds
+        from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+        from gameoflifewithactors_tpu.parallel import sharded
+
+        m = self._mesh((2, 2))
+        g = seeds.seeded((64, 64), "glider", 1, 1)  # NW tile only
+        p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+        run = sharded.make_multi_step_packed_sparse(m, CONWAY, Topology.TORUS)
+        out, flags = run(p, sharded.initial_flags(m), 4)
+        f = np.asarray(flags)
+        assert f[0, 0] == 1, "tile carrying the glider stays awake"
+        # after ~100 gens the glider has crossed into other tiles; full
+        # trajectory must match the dense engine exactly
+        out, flags = run(out, flags, 116)
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            bitpack.pack(jnp.asarray(g)), 120, rule=CONWAY, topology=Topology.TORUS)))
+        np.testing.assert_array_equal(np.asarray(bitpack.unpack(out)), want)
+
+    def test_engine_routes_sparse_with_mesh(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.models import seeds
+
+        m = self._mesh()
+        e = Engine(seeds.seeded((64, 128), "blinker", 30, 60), "B3/S23",
+                   mesh=m, backend="sparse")
+        e.step(2)
+        assert e.population() == 3
+        np.testing.assert_array_equal(
+            e.snapshot(), seeds.seeded((64, 128), "blinker", 30, 60))
+        # torus + mesh + sparse is allowed (single-device sparse is DEAD-only)
+        e2 = Engine(seeds.empty((64, 128)), "B3/S23", mesh=m,
+                    backend="sparse", topology=Topology.TORUS)
+        e2.step(5)
+        assert e2.population() == 0
+
+    def test_set_grid_wakes_sleeping_tiles(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.models import seeds
+
+        m = self._mesh()
+        e = Engine(seeds.empty((64, 128)), "B3/S23", mesh=m, backend="sparse")
+        e.step(3)  # empty universe: everything asleep
+        assert np.asarray(e._flags).sum() == 0
+        e.set_grid(seeds.seeded((64, 128), "blinker", 30, 60))
+        e.step(2)  # must compute again, not stay asleep
+        assert e.population() == 3
+
+    def test_mesh_sparse_warns_on_ignored_opts_and_counts_flag_halo(self):
+        import warnings as w
+
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.models import seeds
+
+        m = self._mesh()
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            e = Engine(seeds.empty((64, 128)), "B3/S23", mesh=m,
+                       backend="sparse", sparse_opts={"capacity": 99})
+        assert any("ignores them" in str(c.message) for c in caught)
+        # flag halo rides on top of the grid halo in the estimate
+        plain = Engine(seeds.empty((64, 128)), "B3/S23", mesh=m, backend="packed")
+        row_sends, col_sends = 2 * 4 * 2, 2 * 2 * 4
+        assert (e.halo_bytes_per_gen() - plain.halo_bytes_per_gen()
+                == row_sends * 4 + col_sends * 12)
