@@ -1,0 +1,113 @@
+"""ctypes wrapper + builder for the native C++ actor-per-cell baseline.
+
+The Python actor baseline (actor_gol.py) is architecture-faithful but pays
+the interpreter and the GIL; the reference's Akka.NET dispatcher is truly
+parallel compiled code. This module compiles baselines/native/actor_gol.cpp
+(g++, baked into the image; no pybind11, so plain ctypes) and exposes the
+same ``measure()`` shape, giving BASELINE.md a defensible native
+denominator for the speedup claim.
+
+Run:  python -m baselines.native_gol [--size 64] [--gens 100] [--workers 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import subprocess
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent / "native"
+_SRC = _NATIVE_DIR / "actor_gol.cpp"
+_SO = _NATIVE_DIR / "libactor_gol.so"
+
+
+def build(force: bool = False) -> Path:
+    """Compile the shared library if missing or stale; returns its path."""
+    if not force and _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(_SRC), "-o", str(_SO)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native baseline build failed:\n{proc.stderr}")
+    return _SO
+
+
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(build()))
+        lib.actor_gol_run.restype = ctypes.c_double
+        lib.actor_gol_run.argtypes = [
+            ctypes.c_int, ctypes.c_int,                       # h, w
+            ctypes.POINTER(ctypes.c_uint8),                   # init
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,         # warmup, gens, workers
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,         # torus, birth, survive
+            ctypes.POINTER(ctypes.c_uint8),                   # final_out
+            ctypes.POINTER(ctypes.c_longlong),                # final_pop
+        ]
+        _lib = lib
+    return _lib
+
+
+def run(grid: np.ndarray, gens: int, *, warmup: int = 0, workers: int = 4,
+        torus: bool = True, rule: str = "B3/S23") -> Tuple[np.ndarray, int, float]:
+    """Run the native actor system; returns (final grid, population, seconds)."""
+    from gameoflifewithactors_tpu.models.rules import parse_rule
+
+    r = parse_rule(rule)
+    grid = np.ascontiguousarray(grid, dtype=np.uint8)
+    h, w = grid.shape
+    out = np.zeros_like(grid)
+    pop = ctypes.c_longlong(0)
+    secs = _load().actor_gol_run(
+        h, w,
+        grid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        warmup, gens, workers, int(torus), r.birth_mask, r.survive_mask,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(pop),
+    )
+    return out, int(pop.value), secs
+
+
+def measure(size: int = 64, gens: int = 100, workers: int = 4,
+            seed: str = "glider") -> dict:
+    from gameoflifewithactors_tpu.models import seeds as seeds_lib
+
+    if seed == "glider":
+        grid = seeds_lib.seeded((size, size), "glider", 1, 1)
+    else:
+        grid = (np.random.default_rng(0).random((size, size)) < 0.5).astype(np.uint8)
+
+    _, _, dt = run(grid, gens, warmup=3, workers=workers)
+    return {
+        "metric": f"native C++ actor-per-cell baseline, {size}x{size} Conway "
+                  f"{seed} ({workers} workers)",
+        "value": size * size * gens / dt,
+        "unit": "cell-updates/sec",
+        "messages_per_generation": 13 * size * size,
+        "wall_seconds": dt,
+        "generations": gens,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--gens", type=int, default=100)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--seed", default="glider")
+    args = ap.parse_args()
+    print(json.dumps(measure(args.size, args.gens, args.workers, args.seed)))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,7 @@
 speedup comparison in BASELINE.md is against a broken strawman."""
 
 import numpy as np
+import pytest
 
 from baselines.actor_gol import ActorGrid
 from gameoflifewithactors_tpu.models import seeds
@@ -39,3 +40,52 @@ def test_actor_dead_boundary():
     got = sim.snapshot()
     sim.shutdown()
     np.testing.assert_array_equal(got, numpy_run(g, CONWAY, Topology.DEAD, 2))
+
+
+# -- native C++ baseline ------------------------------------------------------
+
+def _native():
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in image")
+    from baselines import native_gol
+
+    try:
+        native_gol.build()
+    except RuntimeError as e:
+        pytest.skip(f"native build failed: {e}")
+    return native_gol
+
+
+@pytest.mark.parametrize("torus", [True, False])
+def test_native_actor_matches_engine(torus):
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+    ng = _native()
+    rng = np.random.default_rng(2)
+    g = rng.integers(0, 2, size=(16, 32), dtype=np.uint8)
+    want = np.asarray(multi_step(
+        jnp.asarray(g), 6, rule=CONWAY,
+        topology=Topology.TORUS if torus else Topology.DEAD))
+    got, pop, _ = ng.run(g, 6, workers=4, torus=torus)
+    np.testing.assert_array_equal(got, want)
+    assert pop == int(want.sum())
+
+
+def test_native_actor_highlife_rule_masks():
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.rules import HIGHLIFE
+    from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+    ng = _native()
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, size=(20, 20), dtype=np.uint8)
+    want = np.asarray(multi_step(jnp.asarray(g), 4, rule=HIGHLIFE,
+                                 topology=Topology.TORUS))
+    got, _, _ = ng.run(g, 4, workers=2, rule="B36/S23")
+    np.testing.assert_array_equal(got, want)
