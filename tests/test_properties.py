@@ -1,0 +1,116 @@
+"""Property tests (SURVEY.md §5): algebraic invariants over random rules
+and grids, via Hypothesis.
+
+These catch classes of bug the golden-sequence tests cannot: a rule-table
+transposition that happens to preserve the glider, a shift direction that
+only shows on asymmetric rules, a packed-path carry bug on widths the
+fixed tests never use. Example counts are kept modest because every new
+(rule, shape) pair is a fresh XLA compile on the CPU test rig.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from gameoflifewithactors_tpu.models.rules import Rule
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import step_packed
+from gameoflifewithactors_tpu.ops.stencil import Topology, step
+
+# a compact universe of shapes: word-boundary-rich widths, odd heights
+SHAPES = [(7, 32), (16, 64), (23, 96)]
+
+rules = st.builds(
+    Rule,
+    born=st.frozensets(st.integers(0, 8), max_size=9),
+    survive=st.frozensets(st.integers(0, 8), max_size=9),
+)
+shapes = st.sampled_from(SHAPES)
+seeds_ = st.integers(0, 2**32 - 1)
+
+
+def _grid(shape, seed):
+    return np.random.default_rng(seed).integers(0, 2, size=shape, dtype=np.uint8)
+
+
+def _dual(rule: Rule) -> Rule:
+    """Complement duality: stepping the complemented grid under the dual
+    rule complements the original step. B' = {8-k: k not in S},
+    S' = {8-k: k not in B}."""
+    return Rule(
+        born=frozenset(8 - k for k in range(9) if k not in rule.survive),
+        survive=frozenset(8 - k for k in range(9) if k not in rule.born),
+    )
+
+
+@settings(max_examples=12, deadline=None)
+@given(rule=rules, shape=shapes, seed=seeds_)
+def test_complement_duality_dense(rule, shape, seed):
+    g = _grid(shape, seed)
+    lhs = np.asarray(step(jnp.asarray(g), rule=rule, topology=Topology.TORUS))
+    rhs = 1 - np.asarray(
+        step(jnp.asarray(1 - g), rule=_dual(rule), topology=Topology.TORUS)
+    )
+    np.testing.assert_array_equal(lhs, rhs)
+
+
+@settings(max_examples=12, deadline=None)
+@given(rule=rules, shape=shapes, seed=seeds_,
+       topology=st.sampled_from(list(Topology)))
+def test_packed_matches_dense_random_rules(rule, shape, seed, topology):
+    g = _grid(shape, seed)
+    want = np.asarray(step(jnp.asarray(g), rule=rule, topology=topology))
+    got = np.asarray(bitpack.unpack(
+        step_packed(bitpack.pack(jnp.asarray(g)), rule=rule, topology=topology)))
+    np.testing.assert_array_equal(got, want)
+
+
+@settings(max_examples=10, deadline=None)
+@given(rule=rules, shape=shapes, seed=seeds_,
+       dy=st.integers(-5, 5), dx=st.integers(-33, 33))
+def test_translation_equivariance_on_torus(rule, shape, seed, dy, dx):
+    """roll(step(g)) == step(roll(g)) — the stencil must have no absolute
+    position dependence, including across packed word boundaries (the
+    packed path is asserted too: dx up to ±33 crosses word seams)."""
+    g = _grid(shape, seed)
+    a = np.roll(np.asarray(step(jnp.asarray(g), rule=rule, topology=Topology.TORUS)),
+                (dy, dx), axis=(0, 1))
+    b = np.asarray(step(jnp.asarray(np.roll(g, (dy, dx), axis=(0, 1))),
+                        rule=rule, topology=Topology.TORUS))
+    np.testing.assert_array_equal(a, b)
+    bp = np.asarray(bitpack.unpack(step_packed(
+        bitpack.pack(jnp.asarray(np.roll(g, (dy, dx), axis=(0, 1)))),
+        rule=rule, topology=Topology.TORUS)))
+    np.testing.assert_array_equal(bp, b)
+
+
+@settings(max_examples=8, deadline=None)
+@given(rule=rules, topology=st.sampled_from(list(Topology)))
+def test_empty_grid_stays_empty_unless_b0(rule, topology):
+    g = np.zeros((8, 32), dtype=np.uint8)
+    out = np.asarray(step(jnp.asarray(g), rule=rule, topology=topology))
+    if 0 in rule.born:
+        # B0 on an empty torus births everywhere; DEAD boundary interior too
+        assert out.sum() > 0
+    else:
+        assert out.sum() == 0
+
+
+@settings(max_examples=8, deadline=None)
+@given(rule=rules)
+def test_full_torus_is_uniform(rule):
+    """Every cell of a full torus has 8 live neighbors: the next grid is
+    all-ones iff 8 is in the survive set, else all-zeros."""
+    g = np.ones((8, 32), dtype=np.uint8)
+    out = np.asarray(step(jnp.asarray(g), rule=rule, topology=Topology.TORUS))
+    assert (out == (1 if 8 in rule.survive else 0)).all()
+
+
+@settings(max_examples=20, deadline=None)
+@given(shape=shapes, seed=seeds_)
+def test_pack_roundtrip_random(shape, seed):
+    g = _grid(shape, seed)
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(bitpack.pack(jnp.asarray(g)))), g)
+    np.testing.assert_array_equal(bitpack.pack_np(g),
+                                  np.asarray(bitpack.pack(jnp.asarray(g))))
