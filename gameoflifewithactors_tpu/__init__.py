@@ -19,6 +19,15 @@ from .models.rules import (  # noqa: F401
     parse_rule,
 )
 from .models import seeds  # noqa: F401
+from .models.generations import (  # noqa: F401
+    BRIANS_BRAIN,
+    GENERATIONS_REGISTRY,
+    GenRule,
+    STAR_WARS,
+    parse_any,
+    parse_generations,
+)
+from .ops.generations import multi_step_generations, step_generations  # noqa: F401
 from .ops.stencil import Topology, step, multi_step  # noqa: F401
 from .ops.bitpack import pack, unpack, population  # noqa: F401
 from .ops.packed import step_packed, multi_step_packed  # noqa: F401

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from .models.rules import Rule, parse_rule
+from .models.generations import GenRule, parse_any
+from .models.rules import Rule
 from .ops import bitpack
 from .ops.packed import multi_step_packed
 from .ops import pallas_stencil
@@ -64,17 +65,26 @@ class Engine:
     ):
         if backend not in BACKENDS:
             raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
-        self.rule = parse_rule(rule)
+        self.rule = parse_any(rule)
+        self._generations = isinstance(self.rule, GenRule)
+        if self._generations and backend in ("pallas", "sparse"):
+            raise ValueError(
+                f"backend={backend!r} is bit-packed binary-only; Generations "
+                f"rules ({self.rule.notation}) run on the dense path "
+                "(backend='packed' or 'dense' both route there)"
+            )
         self.topology = topology
         self.mesh = mesh
         self.backend = backend
-        grid = jnp.asarray(np.asarray(grid, dtype=np.uint8))
+        np_grid = np.asarray(grid, dtype=np.uint8)
+        self._validate_states(np_grid)
+        grid = jnp.asarray(np_grid)
         if grid.ndim != 2:
             raise ValueError(f"grid must be 2D, got shape {grid.shape}")
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
-        self._packed = backend in ("packed", "pallas", "sparse")
+        self._packed = backend in ("packed", "pallas", "sparse") and not self._generations
         self._sparse = None
         self._flags = None
         if backend == "sparse" and mesh is None and topology is not Topology.DEAD:
@@ -103,7 +113,11 @@ class Engine:
         state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
-            if backend == "sparse":
+            if self._generations:
+                self._run = sharded.make_multi_step_generations(
+                    mesh, self.rule, topology
+                )
+            elif backend == "sparse":
                 if sparse_opts:
                     warnings.warn(
                         "sparse_opts (tile_rows/tile_words/capacity) apply to "
@@ -164,6 +178,12 @@ class Engine:
                     s, int(n), rule=self.rule, topology=self.topology,
                     interpret=interpret,
                 )
+        elif self._generations:
+            from .ops.generations import multi_step_generations
+
+            self._run = lambda s, n: multi_step_generations(
+                s, n, rule=self.rule, topology=self.topology
+            )
         elif backend == "packed":
             self._run = lambda s, n: multi_step_packed(
                 s, n, rule=self.rule, topology=self.topology
@@ -239,15 +259,28 @@ class Engine:
         return total
 
     def population(self) -> int:
-        """Exact live-cell count (device-side popcount, host-side total)."""
+        """Exact live-cell count (device-side popcount, host-side total).
+
+        For Generations rules only state 1 is *alive* — dying states occupy
+        space but are not population (they do not excite neighbors)."""
         if self._packed:
             return bitpack.population(self.state)
-        return int(np.asarray(jnp.sum(self._state, axis=-1, dtype=jnp.uint32)).sum())
+        cells = (self._state == 1) if self._generations else self._state
+        return int(np.asarray(jnp.sum(cells, axis=-1, dtype=jnp.uint32)).sum())
 
     # -- state injection (checkpoint restore, pattern editing) ---------------
 
+    def _validate_states(self, np_grid: np.ndarray) -> None:
+        if self._generations and np_grid.size and int(np_grid.max()) >= self.rule.states:
+            raise ValueError(
+                f"grid holds state {int(np_grid.max())} but rule "
+                f"{self.rule.notation} has only states 0..{self.rule.states - 1}"
+            )
+
     def set_grid(self, grid, generation: Optional[int] = None) -> None:
-        grid = jnp.asarray(np.asarray(grid, dtype=np.uint8))
+        np_grid = np.asarray(grid, dtype=np.uint8)
+        self._validate_states(np_grid)
+        grid = jnp.asarray(np_grid)
         if tuple(grid.shape) != self.shape:
             raise ValueError(f"grid shape {grid.shape} != engine shape {self.shape}")
         state = bitpack.pack(grid) if self._packed else grid

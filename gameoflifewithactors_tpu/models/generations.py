@@ -1,0 +1,120 @@
+"""Generations rule family — multi-state cellular automata.
+
+The reference implements exactly one rule (Conway B3/S23) hardcoded in its
+CellActor [SURVEY.md §3]; this framework treats the rule as a value. The
+Generations family extends life-like B/S rules with refractory states:
+state 1 is *alive* (the only state neighbors count), a live cell that
+fails survival starts *dying* through states 2..C-1 (it occupies space but
+no longer excites neighbors), and only from state C-1 does it return to
+dead 0. C=2 degenerates to plain life-like, so C >= 3 here.
+
+Notation: "B2/S/C3" (Brian's Brain) — also accepted with G instead of C,
+and Golly's "survive/born/states" digit form "2/13/21" is not supported
+(ambiguous with multi-digit counts); use the explicit B/S/C form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import FrozenSet
+
+from .rules import Rule, parse_rule
+
+
+@dataclasses.dataclass(frozen=True)
+class GenRule:
+    """An outer-totalistic Generations rule: born/survive count sets + the
+    number of cell states C (0 = dead, 1 = alive, 2..C-1 = dying)."""
+
+    born: FrozenSet[int]
+    survive: FrozenSet[int]
+    states: int
+
+    def __post_init__(self):
+        object.__setattr__(self, "born", frozenset(self.born))
+        object.__setattr__(self, "survive", frozenset(self.survive))
+        if not all(0 <= n <= 8 for n in self.born | self.survive):
+            raise ValueError(f"neighbor counts must be 0..8: {self}")
+        if not 3 <= self.states <= 256:
+            raise ValueError(
+                f"Generations needs 3..256 states (C=2 is plain life-like; "
+                f"use Rule), got {self.states}"
+            )
+
+    @property
+    def birth_mask(self) -> int:
+        m = 0
+        for n in self.born:
+            m |= 1 << n
+        return m
+
+    @property
+    def survive_mask(self) -> int:
+        m = 0
+        for n in self.survive:
+            m |= 1 << n
+        return m
+
+    @property
+    def notation(self) -> str:
+        return (
+            "B" + "".join(str(n) for n in sorted(self.born))
+            + "/S" + "".join(str(n) for n in sorted(self.survive))
+            + f"/C{self.states}"
+        )
+
+    def __str__(self) -> str:
+        return self.notation
+
+
+_GEN_RE = re.compile(
+    r"^B(?P<b>[0-8]*)/S(?P<s>[0-8]*)/[CG](?P<c>\d+)$", re.IGNORECASE
+)
+
+GENERATIONS_REGISTRY = {}
+
+
+def _mk(b: str, s: str, c: int, name: str) -> GenRule:
+    r = GenRule(frozenset(int(x) for x in b), frozenset(int(x) for x in s), c)
+    GENERATIONS_REGISTRY[name] = r
+    return r
+
+
+BRIANS_BRAIN = _mk("2", "", 3, "brain")
+STAR_WARS = _mk("2", "345", 4, "starwars")
+FROGS = _mk("34", "12", 3, "frogs")
+BELZHAB = _mk("23", "23", 8, "belzhab")
+
+
+def parse_generations(spec: "str | GenRule") -> GenRule:
+    """Parse "B2/S/C3"-style notation or a registered name."""
+    if isinstance(spec, GenRule):
+        return spec
+    key = spec.strip().lower().replace(" ", "").replace("'", "")
+    if key in GENERATIONS_REGISTRY:
+        return GENERATIONS_REGISTRY[key]
+    m = _GEN_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"not a Generations rule: {spec!r} (want 'B…/S…/C<n>' or one of "
+            f"{sorted(GENERATIONS_REGISTRY)})"
+        )
+    return GenRule(
+        frozenset(int(x) for x in m.group("b")),
+        frozenset(int(x) for x in m.group("s")),
+        int(m.group("c")),
+    )
+
+
+def parse_any(spec: "str | Rule | GenRule") -> "Rule | GenRule":
+    """Life-like or Generations, decided by the *shape* of the spec — a
+    string that matches the B/S/C form dispatches to the Generations parser
+    so its validation errors (e.g. a bad state count) surface verbatim
+    instead of degrading to 'unrecognized rule'."""
+    if isinstance(spec, (Rule, GenRule)):
+        return spec
+    key = spec.strip().lower().replace(" ", "").replace("'", "")
+    if key in GENERATIONS_REGISTRY or _GEN_RE.match(spec.strip()):
+        return parse_generations(spec)
+    return parse_rule(spec)

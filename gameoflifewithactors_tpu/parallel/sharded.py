@@ -131,6 +131,14 @@ def initial_flags(mesh: Mesh) -> jax.Array:
     )
 
 
+def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.TORUS) -> Callable:
+    """Jitted (grid, n) -> grid for multi-state Generations rules: the same
+    halo machinery, a different per-tile step (ops/generations.py)."""
+    from ..ops.generations import step_generations_ext
+
+    return _make_runner(mesh, rule, topology, step_generations_ext, multi=True)
+
+
 def make_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:
     """Jitted sharded step on an unpacked (H, W) uint8 grid (debug path)."""
     return _make_runner(mesh, rule, topology, _dense_ext_step, multi=False)

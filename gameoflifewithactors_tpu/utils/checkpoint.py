@@ -18,10 +18,11 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..engine import Engine
-from ..models.rules import parse_rule
+from ..models.generations import parse_any
 from ..ops.stencil import Topology
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2 adds the multistate (1 byte/cell) Generations layout
+_READABLE_VERSIONS = (1, 2)  # v1 files (binary, packbits) load unchanged
 
 
 def save(engine: Engine, path: "str | Path") -> Path:
@@ -29,17 +30,22 @@ def save(engine: Engine, path: "str | Path") -> Path:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     grid = engine.snapshot()
+    multistate = bool(grid.max(initial=0) > 1)  # Generations states
     meta = dict(
         version=FORMAT_VERSION,
         rule=engine.rule.notation,
         topology=engine.topology.value,
         generation=engine.generation,
         shape=list(engine.shape),
+        multistate=multistate,
     )
-    # packbits: 1 bit/cell on disk regardless of engine backend
-    bits = np.packbits(grid, axis=1)
     with open(path, "wb") as f:
-        np.savez_compressed(f, bits=bits, meta=json.dumps(meta))
+        if multistate:
+            # 1 byte/cell: Generations cells carry dying-state values
+            np.savez_compressed(f, cells=grid, meta=json.dumps(meta))
+        else:
+            # packbits: 1 bit/cell on disk regardless of engine backend
+            np.savez_compressed(f, bits=np.packbits(grid, axis=1), meta=json.dumps(meta))
     return path
 
 
@@ -47,12 +53,15 @@ def load_grid(path: "str | Path") -> Tuple[np.ndarray, dict]:
     """Read (grid, metadata) from a checkpoint without building an engine."""
     with np.load(Path(path), allow_pickle=False) as z:
         meta = json.loads(str(z["meta"]))
-        if meta.get("version") != FORMAT_VERSION:
+        if meta.get("version") not in _READABLE_VERSIONS:
             raise ValueError(
                 f"unsupported checkpoint version {meta.get('version')!r} in {path}"
             )
         h, w = meta["shape"]
-        grid = np.unpackbits(z["bits"], axis=1)[:, :w].astype(np.uint8)
+        if meta.get("multistate"):
+            grid = np.asarray(z["cells"], dtype=np.uint8)
+        else:
+            grid = np.unpackbits(z["bits"], axis=1)[:, :w].astype(np.uint8)
     return grid, meta
 
 
@@ -66,7 +75,7 @@ def load_engine(
     grid, meta = load_grid(path)
     engine = Engine(
         grid,
-        parse_rule(meta["rule"]),
+        parse_any(meta["rule"]),
         topology=Topology(meta["topology"]),
         mesh=mesh,
         backend=backend,

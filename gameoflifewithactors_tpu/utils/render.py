@@ -19,7 +19,9 @@ _ANSI_CLEAR = "\x1b[2J"
 
 
 class ConsoleRenderer:
-    """Draws frames as text. ``charset``: (dead, alive) glyphs."""
+    """Draws frames as text. ``charset``: one glyph per cell state —
+    (dead, alive) for binary rules; longer strings map Generations dying
+    states to their own glyphs (values past the end reuse the last)."""
 
     def __init__(
         self,
@@ -30,8 +32,8 @@ class ConsoleRenderer:
     ):
         self.stream = stream if stream is not None else sys.stdout
         self.ansi = self.stream.isatty() if ansi is None else ansi
-        if len(charset) != 2:
-            raise ValueError("charset must be exactly (dead, alive) two glyphs")
+        if len(charset) < 2:
+            raise ValueError("charset needs at least (dead, alive) glyphs")
         self.charset = charset
         self._first = True
 
@@ -39,9 +41,9 @@ class ConsoleRenderer:
         out = []
         if self.ansi:
             out.append(_ANSI_CLEAR + _ANSI_HOME if self._first else _ANSI_HOME)
-        dead, alive = self.charset
+        chars, top = self.charset, len(self.charset) - 1
         for row in frame.grid:
-            out.append("".join(alive if v else dead for v in row))
+            out.append("".join(chars[min(v, top)] for v in row))
             out.append("\n")
         status = f"gen {frame.generation}  grid {frame.full_shape[0]}x{frame.full_shape[1]}"
         if frame.grid.shape != frame.full_shape:

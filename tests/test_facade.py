@@ -181,7 +181,7 @@ def test_console_renderer_output():
     assert "##" in text
     assert "gen 1" in text and "pop 4" in text
     with pytest.raises(ValueError):
-        ConsoleRenderer(out, charset="###")
+        ConsoleRenderer(out, charset="#")  # need at least (dead, alive)
 
 
 def test_engine_pallas_backend():

@@ -1,0 +1,179 @@
+"""Generations (multi-state) rule family: parser, stepper, engine, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu import Engine
+from gameoflifewithactors_tpu.models.generations import (
+    BRIANS_BRAIN,
+    STAR_WARS,
+    GenRule,
+    parse_any,
+    parse_generations,
+)
+from gameoflifewithactors_tpu.models.rules import CONWAY, Rule
+from gameoflifewithactors_tpu.ops.generations import (
+    multi_step_generations,
+    step_generations,
+)
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def oracle(g: np.ndarray, rule: GenRule, torus: bool, n: int) -> np.ndarray:
+    """Plain-NumPy Generations reference."""
+    g = g.astype(np.int32)
+    for _ in range(n):
+        alive = (g == 1).astype(np.int32)
+        p = np.pad(alive, 1, mode="wrap") if torus else np.pad(alive, 1)
+        cnt = sum(
+            p[1 + dr : p.shape[0] - 1 + dr, 1 + dc : p.shape[1] - 1 + dc]
+            for dr in (-1, 0, 1)
+            for dc in (-1, 0, 1)
+            if (dr, dc) != (0, 0)
+        )
+        born = (g == 0) & np.isin(cnt, sorted(rule.born))
+        keep = (g == 1) & np.isin(cnt, sorted(rule.survive))
+        g = np.where(born | keep, 1, np.where(g == 0, 0, (g + 1) % rule.states))
+    return g.astype(np.uint8)
+
+
+# -- parsing ------------------------------------------------------------------
+
+def test_parse_notation_and_names():
+    assert parse_generations("B2/S/C3") == BRIANS_BRAIN
+    assert parse_generations("b2/s/g3") == BRIANS_BRAIN
+    assert parse_generations("brain") == BRIANS_BRAIN
+    assert parse_generations("starwars") == STAR_WARS
+    assert BRIANS_BRAIN.notation == "B2/S/C3"
+    for bad in ("B2/S", "B2/S/C2", "B9/S/C3", "C3", "banana"):
+        with pytest.raises(ValueError):
+            parse_generations(bad)
+
+
+def test_parse_any_dispatch():
+    assert isinstance(parse_any("B3/S23"), Rule)
+    assert parse_any("conway") == CONWAY
+    assert isinstance(parse_any("B2/S/C3"), GenRule)
+    assert parse_any(BRIANS_BRAIN) is BRIANS_BRAIN
+
+
+# -- stepper vs oracle --------------------------------------------------------
+
+@pytest.mark.parametrize("rule", [BRIANS_BRAIN, STAR_WARS,
+                                  GenRule(frozenset({2, 3}), frozenset({2, 3}), 8)],
+                         ids=str)
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_generations_matches_oracle(rule, topology):
+    rng = np.random.default_rng(4)
+    g = rng.integers(0, rule.states, size=(24, 40), dtype=np.uint8)
+    want = oracle(g, rule, topology is Topology.TORUS, 8)
+    got = np.asarray(multi_step_generations(
+        jnp.asarray(g), 8, rule=rule, topology=topology))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dying_cells_do_not_excite():
+    """A state-2 (dying) cell must not count as a neighbor: two dying cells
+    beside a dead cell with no live neighbors birth nothing under B2."""
+    g = np.zeros((5, 5), dtype=np.uint8)
+    g[2, 1] = 2
+    g[2, 3] = 2
+    out = np.asarray(step_generations(jnp.asarray(g), rule=BRIANS_BRAIN))
+    assert out[2, 2] == 0          # no birth from dying neighbors
+    assert out[2, 1] == 0 and out[2, 3] == 0  # dying C3 cells die
+
+
+def test_brians_brain_everything_dies_without_birth():
+    """Brian's Brain has S = {}: every live cell starts dying immediately."""
+    g = np.zeros((8, 8), dtype=np.uint8)
+    g[3, 3] = 1
+    out = np.asarray(step_generations(jnp.asarray(g), rule=BRIANS_BRAIN))
+    assert out[3, 3] == 2
+
+
+# -- engine / facade / checkpoint --------------------------------------------
+
+def test_engine_generations_population_counts_alive_only():
+    g = np.zeros((8, 32), dtype=np.uint8)
+    g[2, 2] = 1
+    g[2, 3] = 1
+    g[5, 5] = 2  # dying: occupies space, not population
+    e = Engine(g, "B2/S/C3")
+    assert e.population() == 2
+    e.step(1)
+    np.testing.assert_array_equal(
+        e.snapshot(), oracle(g, BRIANS_BRAIN, True, 1))
+
+
+def test_engine_rejects_out_of_range_states_and_packed_kernels():
+    g = np.full((4, 32), 3, dtype=np.uint8)
+    with pytest.raises(ValueError, match="states 0..2"):
+        Engine(g, "B2/S/C3")
+    with pytest.raises(ValueError, match="binary-only"):
+        Engine(np.zeros((4, 32), np.uint8), "B2/S/C3", backend="pallas")
+
+
+def test_generations_checkpoint_roundtrip(tmp_path):
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(6)
+    g = rng.integers(0, 4, size=(16, 32), dtype=np.uint8)
+    e = Engine(g, "starwars")
+    e.step(5)
+    p = ckpt.save(e, tmp_path / "gen.npz")
+    e2 = ckpt.load_engine(p)
+    assert e2.rule == STAR_WARS and e2.generation == 5
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+    e.step(3)
+    e2.step(3)
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+
+
+def test_generations_sharded_bit_identity():
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    rng = np.random.default_rng(7)
+    g = rng.integers(0, 3, size=(32, 64), dtype=np.uint8)
+    single = Engine(g, BRIANS_BRAIN)
+    meshed = Engine(g, BRIANS_BRAIN, mesh=m)
+    single.step(12)
+    meshed.step(12)
+    np.testing.assert_array_equal(meshed.snapshot(), single.snapshot())
+
+
+def test_cli_generations_end_to_end(capsys):
+    from gameoflifewithactors_tpu.cli import main as cli_main
+
+    rc = cli_main(["--grid", "16x32", "--rule", "B2/S/C3", "--seed", "glider",
+                   "--steps", "4", "--render", "final", "--population"])
+    assert rc == 0
+    assert "gen 4" in capsys.readouterr().out
+
+
+def test_renderer_multistate_charset():
+    import io
+
+    from gameoflifewithactors_tpu.coordinator import RenderFrame
+    from gameoflifewithactors_tpu.utils.render import ConsoleRenderer
+
+    buf = io.StringIO()
+    r = ConsoleRenderer(buf, ansi=False, charset=".#*")
+    r(RenderFrame(grid=np.array([[0, 1, 2, 3]], dtype=np.uint8),
+                  generation=1, population=None, full_shape=(1, 4)))
+    assert buf.getvalue().splitlines()[0] == ".#**"  # state 3 reuses last glyph
+
+
+def test_parse_any_surfaces_states_range_error():
+    with pytest.raises(ValueError, match="3..256 states"):
+        parse_any("B2/S/C300")
+    with pytest.raises(ValueError, match="3..256 states"):
+        parse_any("B2/S/C2")
+
+
+def test_set_grid_validates_states():
+    e = Engine(np.zeros((8, 32), np.uint8), "B2/S/C3")
+    with pytest.raises(ValueError, match="states 0..2"):
+        e.set_grid(np.full((8, 32), 7, np.uint8))
