@@ -27,7 +27,9 @@ from .models.generations import (  # noqa: F401
     parse_any,
     parse_generations,
 )
+from .models.ltl import BOSCO, LTL_REGISTRY, LtLRule, parse_ltl  # noqa: F401
 from .ops.generations import multi_step_generations, step_generations  # noqa: F401
+from .ops.ltl import multi_step_ltl, step_ltl  # noqa: F401
 from .ops.stencil import Topology, step, multi_step  # noqa: F401
 from .ops.bitpack import pack, unpack, population  # noqa: F401
 from .ops.packed import step_packed, multi_step_packed  # noqa: F401

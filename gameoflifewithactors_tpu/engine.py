@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .models.generations import GenRule, parse_any
+from .models.ltl import LtLRule
 from .models.rules import Rule
 from .ops import bitpack
 from .ops.packed import multi_step_packed
@@ -67,11 +68,12 @@ class Engine:
             raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
         self.rule = parse_any(rule)
         self._generations = isinstance(self.rule, GenRule)
-        if self._generations and backend in ("pallas", "sparse"):
+        self._ltl = isinstance(self.rule, LtLRule)
+        if (self._generations or self._ltl) and backend in ("pallas", "sparse"):
             raise ValueError(
-                f"backend={backend!r} is bit-packed binary-only; Generations "
-                f"rules ({self.rule.notation}) run on the dense path "
-                "(backend='packed' or 'dense' both route there)"
+                f"backend={backend!r} is 3x3-binary-only; "
+                f"{type(self.rule).__name__} rules ({self.rule.notation}) run "
+                "on the dense path (backend='packed' or 'dense' both route there)"
             )
         self.topology = topology
         self.mesh = mesh
@@ -84,7 +86,8 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
-        self._packed = backend in ("packed", "pallas", "sparse") and not self._generations
+        self._packed = (backend in ("packed", "pallas", "sparse")
+                        and not (self._generations or self._ltl))
         self._sparse = None
         self._flags = None
         if backend == "sparse" and mesh is None and topology is not Topology.DEAD:
@@ -113,7 +116,16 @@ class Engine:
         state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
-            if self._generations:
+            if self._ltl:
+                r = self.rule.radius
+                if self.shape[0] // nx < r or self.shape[1] // ny < r:
+                    raise ValueError(
+                        f"mesh tiles {self.shape[0] // nx}x{self.shape[1] // ny} "
+                        f"smaller than the rule radius {r}: halo exchange "
+                        "needs depth <= tile size; use fewer devices"
+                    )
+                self._run = sharded.make_multi_step_ltl(mesh, self.rule, topology)
+            elif self._generations:
                 self._run = sharded.make_multi_step_generations(
                     mesh, self.rule, topology
                 )
@@ -178,6 +190,12 @@ class Engine:
                     s, int(n), rule=self.rule, topology=self.topology,
                     interpret=interpret,
                 )
+        elif self._ltl:
+            from .ops.ltl import multi_step_ltl
+
+            self._run = lambda s, n: multi_step_ltl(
+                s, n, rule=self.rule, topology=self.topology
+            )
         elif self._generations:
             from .ops.generations import multi_step_generations
 
@@ -271,10 +289,16 @@ class Engine:
     # -- state injection (checkpoint restore, pattern editing) ---------------
 
     def _validate_states(self, np_grid: np.ndarray) -> None:
-        if self._generations and np_grid.size and int(np_grid.max()) >= self.rule.states:
+        top = int(np_grid.max()) if np_grid.size else 0
+        if self._generations and top >= self.rule.states:
             raise ValueError(
-                f"grid holds state {int(np_grid.max())} but rule "
-                f"{self.rule.notation} has only states 0..{self.rule.states - 1}"
+                f"grid holds state {top} but rule {self.rule.notation} "
+                f"has only states 0..{self.rule.states - 1}"
+            )
+        if not self._generations and top > 1:
+            raise ValueError(
+                f"grid holds value {top} but rule {self.rule.notation} "
+                "is binary: cells must be 0 or 1"
             )
 
     def set_grid(self, grid, generation: Optional[int] = None) -> None:

@@ -107,14 +107,18 @@ def parse_generations(spec: "str | GenRule") -> GenRule:
     )
 
 
-def parse_any(spec: "str | Rule | GenRule") -> "Rule | GenRule":
-    """Life-like or Generations, decided by the *shape* of the spec — a
-    string that matches the B/S/C form dispatches to the Generations parser
-    so its validation errors (e.g. a bad state count) surface verbatim
-    instead of degrading to 'unrecognized rule'."""
-    if isinstance(spec, (Rule, GenRule)):
+def parse_any(spec):
+    """Life-like, Generations, or Larger-than-Life, decided by the *shape*
+    of the spec — a string matching a family's form dispatches to that
+    family's parser so validation errors (e.g. a bad state count) surface
+    verbatim instead of degrading to 'unrecognized rule'."""
+    from .ltl import _LTL_RE, LTL_REGISTRY, LtLRule, parse_ltl
+
+    if isinstance(spec, (Rule, GenRule, LtLRule)):
         return spec
     key = spec.strip().lower().replace(" ", "").replace("'", "")
     if key in GENERATIONS_REGISTRY or _GEN_RE.match(spec.strip()):
         return parse_generations(spec)
+    if key in LTL_REGISTRY or _LTL_RE.match(spec.strip()):
+        return parse_ltl(spec)
     return parse_rule(spec)

@@ -1,0 +1,101 @@
+"""Larger-than-Life rule family — radius-r Moore neighborhoods.
+
+Life-like rules look at 8 neighbors; Larger-than-Life (Evans) counts live
+cells in a (2r+1)² box and births/survives on *intervals*. This is the
+family where the TPU's MXU earns its keep: the box count is a separable
+pair of 1-D convolutions in bf16 (exact for counts < 256, i.e. r <= 7)
+instead of the VPU bitwise path the 3×3 rules use.
+
+Notation (Golly's LtL form): ``R5,C0,M1,S34..58,B34..45`` —
+radius R, states C (only C0/C2 = binary supported here), M1 counts the
+center cell itself in the survival window (M0 excludes it), S/B are
+inclusive count intervals. Named rules: "bosco" (the classic), "bugs",
+"majority" (radius-4 majority vote).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Tuple
+
+MAX_RADIUS = 7  # (2r+1)^2 - 1 < 256 keeps bf16 MXU accumulation exact
+
+
+@dataclasses.dataclass(frozen=True)
+class LtLRule:
+    """Binary Larger-than-Life: interval birth/survival over a radius-r box."""
+
+    radius: int
+    born: Tuple[int, int]       # inclusive [lo, hi]
+    survive: Tuple[int, int]    # inclusive [lo, hi]
+    middle: bool = True         # M1: a live cell counts itself in its window
+
+    def __post_init__(self):
+        if not 1 <= self.radius <= MAX_RADIUS:
+            raise ValueError(
+                f"radius must be 1..{MAX_RADIUS} (bf16-exact window sums), "
+                f"got {self.radius}"
+            )
+        full = (2 * self.radius + 1) ** 2
+        for name, (lo, hi) in (("born", self.born), ("survive", self.survive)):
+            if not (0 <= lo <= hi <= full):
+                raise ValueError(
+                    f"{name} interval {lo}..{hi} outside 0..{full} "
+                    f"for radius {self.radius}"
+                )
+
+    @property
+    def notation(self) -> str:
+        return (
+            f"R{self.radius},C0,M{int(self.middle)},"
+            f"S{self.survive[0]}..{self.survive[1]},"
+            f"B{self.born[0]}..{self.born[1]}"
+        )
+
+    def __str__(self) -> str:
+        return self.notation
+
+
+_LTL_RE = re.compile(
+    r"^R(?P<r>\d+),C(?P<c>\d+),M(?P<m>[01]),"
+    r"S(?P<s1>\d+)\.\.(?P<s2>\d+),B(?P<b1>\d+)\.\.(?P<b2>\d+)$",
+    re.IGNORECASE,
+)
+
+LTL_REGISTRY = {}
+
+
+def _mk(spec: str, name: str) -> LtLRule:
+    r = parse_ltl(spec)
+    LTL_REGISTRY[name] = r
+    return r
+
+
+def parse_ltl(spec: "str | LtLRule") -> LtLRule:
+    if isinstance(spec, LtLRule):
+        return spec
+    key = spec.strip().lower().replace(" ", "")
+    if key in LTL_REGISTRY:
+        return LTL_REGISTRY[key]
+    m = _LTL_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"not a Larger-than-Life rule: {spec!r} (want "
+            f"'R5,C0,M1,S34..58,B34..45' or one of {sorted(LTL_REGISTRY)})"
+        )
+    if m.group("c") not in ("0", "2"):
+        raise ValueError(
+            f"only binary LtL supported (C0/C2), got C{m.group('c')}"
+        )
+    return LtLRule(
+        radius=int(m.group("r")),
+        born=(int(m.group("b1")), int(m.group("b2"))),
+        survive=(int(m.group("s1")), int(m.group("s2"))),
+        middle=m.group("m") == "1",
+    )
+
+
+BOSCO = _mk("R5,C0,M1,S34..58,B34..45", "bosco")
+BUGS = _mk("R5,C0,M1,S34..58,B34..45", "bugs")  # alias: Bosco's rule IS "Bugs"
+MAJORITY = _mk("R4,C0,M1,S41..81,B41..81", "majority")

@@ -1,0 +1,83 @@
+"""Larger-than-Life stepper: separable box-sum convolutions on the MXU.
+
+The 3×3 families ride the VPU (bitwise SWAR / byte selects); a radius-r
+box count is 2·(2r+1) MACs per cell, which is convolution work — so this
+path feeds the MXU. The (2r+1)² box is separable: a (2r+1)×1 column conv
+then a 1×(2r+1) row conv. Inputs are cast to bf16 on TPU (f32 elsewhere)
+with f32 accumulation; counts are integers < 256 for r <= 7, so the
+arithmetic is exact (models/ltl.py caps the radius accordingly).
+
+Same halo-extension contract as every other stepper in ops/: the `_ext`
+variant consumes a (h+2r, w+2r) tile with halos already materialised —
+by jnp.pad here, or by depth-r ppermute exchange in parallel/sharded.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.ltl import LtLRule
+from .stencil import Topology, _pad_mode
+
+
+def _compute_dtype() -> jnp.dtype:
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+def box_sums_ext(ext: jax.Array, radius: int) -> jax.Array:
+    """(h+2r, w+2r) {0,1} tile -> (h, w) f32 window sums (center included).
+
+    Two 1-D VALID convolutions; XLA maps them onto the MXU on TPU.
+    """
+    r = radius
+    k = 2 * r + 1
+    x = ext.astype(_compute_dtype())[None, None, :, :]          # NCHW
+    col = jnp.ones((1, 1, k, 1), x.dtype)
+    row = jnp.ones((1, 1, 1, k), x.dtype)
+    dn = ("NCHW", "OIHW", "NCHW")
+    y = lax.conv_general_dilated(
+        x, col, (1, 1), "VALID", dimension_numbers=dn,
+        preferred_element_type=jnp.float32)
+    y = lax.conv_general_dilated(
+        y.astype(x.dtype), row, (1, 1), "VALID", dimension_numbers=dn,
+        preferred_element_type=jnp.float32)
+    return y[0, 0]
+
+
+def step_ltl_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
+    """One generation from a halo-extended (h+2r, w+2r) uint8 tile."""
+    r = rule.radius
+    state = ext[r:-r, r:-r]
+    sums = box_sums_ext(ext, r)
+    count = sums - (0.0 if rule.middle else state.astype(jnp.float32))
+    alive = state.astype(bool)
+    (b1, b2), (s1, s2) = rule.born, rule.survive
+    born = (~alive) & (count >= b1) & (count <= b2)
+    keep = alive & (count >= s1) & (count <= s2)
+    return (born | keep).astype(jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+def step_ltl(state: jax.Array, *, rule: LtLRule,
+             topology: Topology = Topology.TORUS) -> jax.Array:
+    """One generation on an unpacked (H, W) uint8 binary grid."""
+    return step_ltl_ext(jnp.pad(state, rule.radius, **_pad_mode(topology)), rule)
+
+
+@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+def multi_step_ltl(
+    state: jax.Array,
+    n: jax.Array,
+    *,
+    rule: LtLRule,
+    topology: Topology = Topology.TORUS,
+) -> jax.Array:
+    """``n`` generations in one jitted fori_loop."""
+    body = lambda _, s: step_ltl_ext(
+        jnp.pad(s, rule.radius, **_pad_mode(topology)), rule
+    )
+    return jax.lax.fori_loop(0, n, body, state)

@@ -43,30 +43,39 @@ def _shift_perm(n: int, direction: int, wrap: bool) -> List[Tuple[int, int]]:
     return perm
 
 
-def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis: str = ROW_AXIS) -> jax.Array:
-    """(h, w) tile -> (h+2, w) with north/south halo rows from mesh neighbors."""
+def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis: str = ROW_AXIS,
+                  depth: int = 1) -> jax.Array:
+    """(h, w) tile -> (h+2·depth, w) with north/south halo strips of
+    ``depth`` rows from mesh neighbors (depth > 1 serves radius-r stencils
+    like Larger-than-Life; requires depth <= tile height)."""
     wrap = topology is Topology.TORUS
-    # My north halo row is my north neighbor's bottom row: data flows +1.
-    north = lax.ppermute(tile[-1:], axis, _shift_perm(nx, +1, wrap))
-    south = lax.ppermute(tile[:1], axis, _shift_perm(nx, -1, wrap))
+    # My north halo rows are my north neighbor's bottom rows: data flows +1.
+    north = lax.ppermute(tile[-depth:], axis, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(tile[:depth], axis, _shift_perm(nx, -1, wrap))
     return jnp.concatenate([north, tile, south], axis=0)
 
 
-def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_AXIS) -> jax.Array:
-    """(h+2, w) row-extended tile -> (h+2, w+2) with west/east halo columns
-    (including the diagonal corners carried in the extended rows)."""
+def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_AXIS,
+                  depth: int = 1) -> jax.Array:
+    """(h+2d, w) row-extended tile -> (h+2d, w+2d) with west/east halo
+    columns (the diagonal corners ride in the already-extended rows)."""
     wrap = topology is Topology.TORUS
-    west = lax.ppermute(ext[:, -1:], axis, _shift_perm(ny, +1, wrap))
-    east = lax.ppermute(ext[:, :1], axis, _shift_perm(ny, -1, wrap))
+    west = lax.ppermute(ext[:, -depth:], axis, _shift_perm(ny, +1, wrap))
+    east = lax.ppermute(ext[:, :depth], axis, _shift_perm(ny, -1, wrap))
     return jnp.concatenate([west, ext, east], axis=1)
 
 
-def exchange_halo(tile: jax.Array, nx: int, ny: int, topology: Topology) -> jax.Array:
-    """Full two-phase exchange: (h, w) tile -> (h+2, w+2) haloed tile.
+def exchange_halo(tile: jax.Array, nx: int, ny: int, topology: Topology,
+                  depth: int = 1) -> jax.Array:
+    """Full two-phase exchange: (h, w) tile -> (h+2d, w+2d) haloed tile.
 
-    Works identically for unpacked (halo = 1 cell strip) and packed tiles
-    (halo = 1 word strip, of which the stencil consumes 1 bit — shipping
+    Works identically for unpacked (halo = cell strips) and packed tiles
+    (halo = word strips, of which the 3×3 stencil consumes 1 bit — shipping
     whole words keeps payloads aligned; at 32768 rows/tile the E/W halo is
-    128 KB, negligible on ICI).
+    128 KB, negligible on ICI). ``depth`` d exchanges d-deep strips for
+    radius-d neighborhoods; the two phases make the (d, d) corner blocks
+    correct with 4 sends, no diagonal messages.
     """
-    return exchange_cols(exchange_rows(tile, nx, topology), ny, topology)
+    return exchange_cols(
+        exchange_rows(tile, nx, topology, depth=depth), ny, topology, depth=depth
+    )

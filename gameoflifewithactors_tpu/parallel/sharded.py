@@ -43,15 +43,16 @@ def _dense_ext_step(ext: jax.Array, rule: Rule) -> jax.Array:
 
 def _make_runner(
     mesh: Mesh,
-    rule: Rule,
+    rule,
     topology: Topology,
-    ext_step: Callable[[jax.Array, Rule], jax.Array],
+    ext_step: Callable[[jax.Array, "Rule"], jax.Array],
     multi: bool,
+    depth: int = 1,
 ) -> Callable:
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
     def generation(tile):
-        return ext_step(exchange_halo(tile, nx, ny, topology), rule)
+        return ext_step(exchange_halo(tile, nx, ny, topology, depth=depth), rule)
 
     if multi:
         @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
@@ -137,6 +138,18 @@ def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.
     from ..ops.generations import step_generations_ext
 
     return _make_runner(mesh, rule, topology, step_generations_ext, multi=True)
+
+
+def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS) -> Callable:
+    """Jitted (grid, n) -> grid for radius-r Larger-than-Life rules: the
+    halo exchange ships depth-r strips (halo.py's two-phase trip keeps the
+    r×r corner blocks correct with 4 sends), the per-tile step is the MXU
+    conv path (ops/ltl.py). Tiles must be at least r cells in each dim."""
+    from ..ops.ltl import step_ltl_ext
+
+    return _make_runner(
+        mesh, rule, topology, step_ltl_ext, multi=True, depth=rule.radius
+    )
 
 
 def make_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:

@@ -32,7 +32,9 @@ def save(engine: Engine, path: "str | Path") -> Path:
     grid = engine.snapshot()
     multistate = bool(grid.max(initial=0) > 1)  # Generations states
     meta = dict(
-        version=FORMAT_VERSION,
+        # binary/packbits files keep the v1 stamp (layout unchanged, old
+        # readers still load them); only the multistate layout needs v2
+        version=2 if multistate else 1,
         rule=engine.rule.notation,
         topology=engine.topology.value,
         generation=engine.generation,

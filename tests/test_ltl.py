@@ -1,0 +1,162 @@
+"""Larger-than-Life: parser, conv stepper vs oracle, deep halos, engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu import Engine
+from gameoflifewithactors_tpu.models.generations import parse_any
+from gameoflifewithactors_tpu.models.ltl import BOSCO, MAJORITY, LtLRule, parse_ltl
+from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl, step_ltl
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def oracle(g: np.ndarray, rule: LtLRule, torus: bool, n: int) -> np.ndarray:
+    """Plain-NumPy LtL reference (direct window sums, int arithmetic)."""
+    r = rule.radius
+    g = g.astype(np.int32)
+    for _ in range(n):
+        p = np.pad(g, r, mode="wrap") if torus else np.pad(g, r)
+        cnt = np.zeros_like(g)
+        for dr in range(-r, r + 1):
+            for dc in range(-r, r + 1):
+                cnt += p[r + dr : p.shape[0] - r + dr, r + dc : p.shape[1] - r + dc]
+        if not rule.middle:
+            cnt -= g
+        (b1, b2), (s1, s2) = rule.born, rule.survive
+        born = (g == 0) & (cnt >= b1) & (cnt <= b2)
+        keep = (g == 1) & (cnt >= s1) & (cnt <= s2)
+        g = (born | keep).astype(np.int32)
+    return g.astype(np.uint8)
+
+
+# -- parsing ------------------------------------------------------------------
+
+def test_parse_notation_and_names():
+    assert parse_ltl("R5,C0,M1,S34..58,B34..45") == BOSCO
+    assert parse_ltl("bosco") == BOSCO
+    assert BOSCO.notation == "R5,C0,M1,S34..58,B34..45"
+    assert parse_any("bosco") == BOSCO
+    assert isinstance(parse_any("R2,C0,M0,S3..8,B5..7"), LtLRule)
+    for bad in ("R5,C0,M1,S34..58", "R0,C0,M1,S1..2,B1..2",
+                "R8,C0,M1,S1..2,B1..2", "R5,C3,M1,S1..2,B1..2",
+                "R2,C0,M1,S9..3,B1..2"):
+        with pytest.raises(ValueError):
+            parse_ltl(bad)
+
+
+def test_radius1_m0_interval_reduces_to_life_like():
+    """R1,M0,S2..3,B3..3 is exactly Conway: cross-check families."""
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.stencil import multi_step
+
+    rule = parse_ltl("R1,C0,M0,S2..3,B3..3")
+    rng = np.random.default_rng(0)
+    g = rng.integers(0, 2, size=(20, 30), dtype=np.uint8)
+    want = np.asarray(multi_step(jnp.asarray(g), 10, rule=CONWAY,
+                                 topology=Topology.TORUS))
+    got = np.asarray(multi_step_ltl(jnp.asarray(g), 10, rule=rule,
+                                    topology=Topology.TORUS))
+    np.testing.assert_array_equal(got, want)
+
+
+# -- stepper vs oracle --------------------------------------------------------
+
+@pytest.mark.parametrize("rule", [BOSCO, MAJORITY,
+                                  parse_ltl("R2,C0,M0,S5..12,B7..10")], ids=str)
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_ltl_matches_oracle(rule, topology):
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 2, size=(40, 48), dtype=np.uint8)
+    want = oracle(g, rule, topology is Topology.TORUS, 4)
+    got = np.asarray(multi_step_ltl(jnp.asarray(g), 4, rule=rule,
+                                    topology=topology))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bosco_long_run_matches_oracle():
+    """40 generations of Bosco on a random soup — long enough for soup to
+    condense into bugs, so any drift between the conv path's f32 counts
+    and exact integer counts would accumulate and diverge."""
+    rng = np.random.default_rng(0)
+    g = (rng.random((96, 96)) < 0.45).astype(np.uint8)
+    want = oracle(g, BOSCO, True, 40)
+    got = np.asarray(multi_step_ltl(jnp.asarray(g), 40, rule=BOSCO,
+                                    topology=Topology.TORUS))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() > 0  # this seed condenses into live bugs, not extinction
+
+
+# -- sharded deep halos -------------------------------------------------------
+
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_ltl_sharded_bit_identity_deep_halo(topology):
+    """Radius-5 halos cross tile boundaries 5 deep; the 2x4 mesh result
+    must equal the single-device result exactly (corner blocks included)."""
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    rng = np.random.default_rng(13)
+    g = rng.integers(0, 2, size=(32, 64), dtype=np.uint8)
+    single = Engine(g, BOSCO, topology=topology)
+    meshed = Engine(g, BOSCO, topology=topology, mesh=m)
+    single.step(6)
+    meshed.step(6)
+    np.testing.assert_array_equal(meshed.snapshot(), single.snapshot())
+
+
+def test_engine_rejects_tiles_smaller_than_radius():
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    with pytest.raises(ValueError, match="smaller than the rule radius"):
+        Engine(np.zeros((8, 16), np.uint8), BOSCO, mesh=m)
+
+
+# -- engine / checkpoint / cli ------------------------------------------------
+
+def test_engine_checkpoint_roundtrip(tmp_path):
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 2, size=(32, 32), dtype=np.uint8)
+    e = Engine(g, "bosco")
+    e.step(3)
+    e2 = ckpt.load_engine(ckpt.save(e, tmp_path / "ltl.npz"))
+    assert e2.rule == BOSCO and e2.generation == 3
+    np.testing.assert_array_equal(e2.snapshot(), e.snapshot())
+
+
+def test_cli_ltl_end_to_end(capsys):
+    from gameoflifewithactors_tpu.cli import main as cli_main
+
+    rc = cli_main(["--grid", "32x32", "--rule", "bosco", "--seed", "random",
+                   "--random-p", "0.4", "--steps", "3", "--render", "final",
+                   "--population"])
+    assert rc == 0
+    assert "gen 3" in capsys.readouterr().out
+
+
+def test_binary_rules_reject_multistate_grids():
+    g = np.full((8, 32), 2, dtype=np.uint8)
+    with pytest.raises(ValueError, match="binary"):
+        Engine(g, "R1,C0,M0,S2..3,B3..3")
+    with pytest.raises(ValueError, match="binary"):
+        Engine(g, "B3/S23")
+    e = Engine(np.zeros((8, 32), np.uint8), "bosco")
+    with pytest.raises(ValueError, match="binary"):
+        e.set_grid(g)
+
+
+def test_checkpoint_version_stamp_per_layout(tmp_path):
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    e = Engine(np.zeros((8, 32), np.uint8), "B3/S23")
+    ckpt.save(e, tmp_path / "bin.npz")
+    assert ckpt.load_grid(tmp_path / "bin.npz")[1]["version"] == 1
+
+    g = np.zeros((8, 32), np.uint8); g[2, 2] = 2
+    e2 = Engine(g, "B2/S/C3")
+    ckpt.save(e2, tmp_path / "multi.npz")
+    assert ckpt.load_grid(tmp_path / "multi.npz")[1]["version"] == 2
