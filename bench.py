@@ -59,7 +59,8 @@ def run_bench(args) -> None:
     honor_jax_platforms_env()
     import jax.numpy as jnp
 
-    from gameoflifewithactors_tpu.models.rules import parse_rule
+    from gameoflifewithactors_tpu.models.generations import GenRule, parse_any
+    from gameoflifewithactors_tpu.models.ltl import LtLRule
     from gameoflifewithactors_tpu.ops import bitpack
     from gameoflifewithactors_tpu.ops.packed import multi_step_packed
     from gameoflifewithactors_tpu.ops.pallas_stencil import (
@@ -70,7 +71,13 @@ def run_bench(args) -> None:
 
     platform = jax.devices()[0].platform
     side = args.size or (16384 if platform != "cpu" else 4096)
-    rule = parse_rule(args.rule)
+    rule = parse_any(args.rule)
+    if isinstance(rule, (GenRule, LtLRule)) and args.backend != "dense":
+        # multi-state / radius-r rules have one (dense) device path
+        sys.stderr.write(
+            f"note: rule {rule.notation} runs on the dense path; "
+            f"--backend {args.backend} ignored\n")
+        args.backend = "dense"
 
     def sync(x) -> int:
         """Force completion: block (a no-op on the tunnel), then fetch a
@@ -105,6 +112,16 @@ def run_bench(args) -> None:
             return sparse_state.packed
 
         state = sparse_state.packed
+    elif isinstance(rule, GenRule):
+        from gameoflifewithactors_tpu.ops.generations import multi_step_generations
+
+        state = jnp.asarray(grid)
+        run = lambda s, n: multi_step_generations(s, n, rule=rule, topology=Topology.TORUS)
+    elif isinstance(rule, LtLRule):
+        from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+
+        state = jnp.asarray(grid)
+        run = lambda s, n: multi_step_ltl(s, n, rule=rule, topology=Topology.TORUS)
     else:
         state = jnp.asarray(grid)
         run = lambda s, n: multi_step(s, n, rule=rule, topology=Topology.TORUS)

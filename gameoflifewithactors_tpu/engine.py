@@ -262,8 +262,10 @@ class Engine:
         h, w = self.shape
         wq = (w // bitpack.WORD) if self._packed else w
         itemsize = 4 if self._packed else 1
-        row_strip = (wq // ny) * itemsize          # 1 row of one tile
-        col_strip = (h // nx + 2) * itemsize       # 1 column of a row-extended tile
+        depth = self.rule.radius if self._ltl else 1  # strip depth in rows/cols
+        row_strip = depth * (wq // ny) * itemsize  # d rows of one tile
+        # d columns of a row-extended (h + 2d rows) tile
+        col_strip = depth * (h // nx + 2 * depth) * itemsize
         wrap = self.topology is Topology.TORUS
         # a size-1 axis exchanges nothing over the interconnect (the torus
         # "send" is a device-local self-copy); DEAD edges drop the wrap send

@@ -1,0 +1,54 @@
+"""Perf smoke guardrail (SURVEY.md §5): catastrophic slowdowns, not tuning.
+
+Floors are ~30x below values measured on the slowest rig this runs on
+(single-vCPU CPU JAX), so they only trip on real regressions — e.g. the
+packed step silently falling back to per-cell work, a donation bug
+forcing full copies, or an accidental host round-trip per generation.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from gameoflifewithactors_tpu.models.rules import CONWAY
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+# 1024: at 512 the dense path still fits caches and the packed advantage
+# shrinks to ~1.3-1.6x under load; at 1024 it is ~4x and stable
+SIDE = 1024
+GENS = 100
+
+
+def _rate(run, state) -> float:
+    state = run(state, 10)  # compile + warm
+    state.block_until_ready()
+    t0 = time.perf_counter()
+    out = run(state, GENS)
+    out.block_until_ready()
+    return SIDE * SIDE * GENS / (time.perf_counter() - t0)
+
+
+def test_packed_rate_floor_and_packing_advantage():
+    rng = np.random.default_rng(0)
+    g = rng.integers(0, 2, size=(SIDE, SIDE), dtype=np.uint8)
+
+    packed_rate = _rate(
+        lambda s, n: multi_step_packed(s, n, rule=CONWAY, topology=Topology.TORUS),
+        bitpack.pack(jnp.asarray(g)),
+    )
+    # measured ~1.2e10 on the 1-vCPU CPU rig; 2e8 only trips on catastrophe
+    assert packed_rate > 2e8, f"packed path collapsed: {packed_rate:.2e}/s"
+
+    dense_rate = _rate(
+        lambda s, n: multi_step(s, n, rule=CONWAY, topology=Topology.TORUS),
+        jnp.asarray(g),
+    )
+    # bit-packing is the framework's stated lever (BASELINE.md): it must
+    # actually win, with margin slack for a loaded machine
+    assert packed_rate > 1.5 * dense_rate, (
+        f"packed ({packed_rate:.2e}/s) lost its advantage over dense "
+        f"({dense_rate:.2e}/s)"
+    )
