@@ -31,3 +31,56 @@ def strip_import_path() -> None:
     """Drop axon-plugin entries from this process's sys.path and PYTHONPATH."""
     sys.path[:] = [p for p in sys.path if _MARKER not in p]
     os.environ["PYTHONPATH"] = strip_pythonpath()
+
+
+def force_cpu(n_devices: int = 1):
+    """Route this process's JAX work to an ``n_devices`` virtual-CPU platform.
+
+    The one shared home for the image-specific staging recipe (used by
+    tests/conftest.py and __graft_entry__.dryrun_multichip; bench.py builds
+    the same env for a child process via :func:`strip_pythonpath`):
+
+    1. If jax is somehow not yet imported, drop the wedge-prone plugin from
+       the import path entirely. (On this image sitecustomize imports jax at
+       interpreter startup, so this branch rarely fires.)
+    2. Stage ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (only if
+       the flag isn't already set) and ``JAX_PLATFORMS=cpu``. Both are
+       consumed lazily at first *backend init*, not at jax import, so this
+       works even with jax already in sys.modules — as long as no device has
+       been queried yet in this process.
+    3. Set the ``jax_platforms`` config too: the tunneled plugin ignores the
+       env var alone, and an argument-less ``jax.devices()`` would otherwise
+       initialize every registered backend, including a wedged tunnel.
+
+    Returns the imported ``jax`` module. If backends were already
+    initialized before the call, the config update is a no-op and callers
+    must additionally pin work with ``jax.default_device``.
+    """
+    if "jax" not in sys.modules:
+        strip_import_path()
+    saved = {k: os.environ.get(k) for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backends already up; caller's jax.default_device pinning applies
+    # Force CPU-backend init NOW (while the staged env is visible), then
+    # restore the env: both knobs are consumed at client creation, and
+    # leaving them mutated would poison children this process later spawns
+    # (e.g. a driver that calls dryrun then runs bench.py would silently
+    # get a CPU bench — VERDICT.md round-1 Weak #2). The in-process
+    # jax_platforms *config* persists, which is exactly the desired scope.
+    jax.devices("cpu")
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    return jax

@@ -10,19 +10,7 @@ production paths never import this; they see the real TPU.
 import os
 import sys
 
-# Drop the tunneled-TPU PJRT plugin from the import path entirely: when the
-# tunnel is wedged (observed repeatedly), plugin discovery hangs `import jax`
-# itself, even under JAX_PLATFORMS=cpu. Tests are CPU-only by design.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import axon_guard  # noqa: E402  (repo-root helper; must not import jax)
 
-axon_guard.strip_import_path()
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402  (env must be staged first)
-
-jax.config.update("jax_platforms", "cpu")
+axon_guard.force_cpu(8)
