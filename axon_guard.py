@@ -77,10 +77,12 @@ def force_cpu(n_devices: int = 1):
     # (e.g. a driver that calls dryrun then runs bench.py would silently
     # get a CPU bench — VERDICT.md round-1 Weak #2). The in-process
     # jax_platforms *config* persists, which is exactly the desired scope.
-    jax.devices("cpu")
-    for k, v in saved.items():
-        if v is None:
-            os.environ.pop(k, None)
-        else:
-            os.environ[k] = v
+    try:
+        jax.devices("cpu")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return jax

@@ -1,0 +1,128 @@
+"""TPU tunnel health probe: classify the axon tunnel's state in <60s.
+
+The tunneled-TPU PJRT plugin on this image has a recurring wedge mode in
+which device calls — and in the worst state ``import jax`` itself — hang
+forever. A 420s bench watchdog discovering this at end-of-round costs the
+round's TPU evidence (VERDICT.md round-1 Weak #2), so bench.py preflights
+with this probe and goes straight to its fallback when the tunnel is not
+``healthy``.
+
+The probe runs a staged child process and reads how far it got:
+
+    import-start -> import-done -> devices-done -> compute-done
+
+``compute-done`` requires a *scalar readback* of a tiny device op —
+``block_until_ready`` is a no-op on the tunnel, so only a dependent
+device->host fetch proves the chip actually executed work.
+
+Statuses:
+  healthy        TPU present, tiny op + readback completed
+  cpu-only       probe completed but no TPU platform was found
+  wedged-import  `import jax` hangs (plugin discovery touches the tunnel)
+  wedged-init    import ok, device/backend init hangs
+  wedged-compute devices enumerate, but the op or its readback hangs
+  error          child died with a traceback (e.g. PJRT init failure)
+
+CLI: ``python scripts/tpu_probe.py [--timeout 60] [--json]``; exit code 0
+iff healthy. Library: ``probe(timeout) -> dict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_CHILD = r"""
+import sys
+def stage(s):
+    sys.stdout.write("STAGE " + s + "\n"); sys.stdout.flush()
+stage("import-start")
+import jax
+stage("import-done")
+devices = jax.devices()
+plat = devices[0].platform
+stage("devices-done %s %d" % (plat, len(devices)))
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.float32)
+val = float(jax.jit(lambda a: (a @ a).sum())(x))  # scalar readback: the only
+stage("compute-done %r" % val)                    # real completion proof here
+"""
+
+
+def probe(timeout: float = 60.0, env: dict | None = None) -> dict:
+    """Run the staged child; classify how far it got before the deadline.
+
+    ``env`` overrides the child's environment (default: inherit) — tests use
+    it to aim the probe at a guaranteed-CPU configuration.
+    """
+    t0 = time.time()
+    with tempfile.TemporaryFile(mode="w+") as out, tempfile.TemporaryFile(mode="w+") as err:
+        p = subprocess.Popen([sys.executable, "-c", _CHILD], stdout=out, stderr=err,
+                             env=env)
+        try:
+            rc = p.wait(timeout=timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            rc, timed_out = None, True
+        out.seek(0)
+        err.seek(0)
+        stages = [ln[6:].strip() for ln in out.read().splitlines()
+                  if ln.startswith("STAGE ")]
+        err_tail = err.read()[-2000:]
+
+    result = {
+        "status": "error",
+        "platform": None,
+        "n_devices": 0,
+        "elapsed_s": round(time.time() - t0, 2),
+        "stages": stages,
+        "detail": "",
+    }
+    for s in stages:
+        if s.startswith("devices-done"):
+            _, plat, n = s.split()
+            result["platform"] = plat
+            result["n_devices"] = int(n)
+
+    last = stages[-1].split()[0] if stages else "(none)"
+    if timed_out:
+        result["status"] = {
+            "(none)": "wedged-import",   # never even reached import-start
+            "import-start": "wedged-import",
+            "import-done": "wedged-init",
+            "devices-done": "wedged-compute",
+        }.get(last, "wedged-compute")
+        result["detail"] = f"child killed after {timeout}s; last stage: {last}"
+    elif rc == 0 and last == "compute-done":
+        tpu = result["platform"] not in (None, "cpu")
+        result["status"] = "healthy" if tpu else "cpu-only"
+        result["detail"] = stages[-1]
+    else:
+        result["detail"] = f"child rc={rc}; last stage: {last}; stderr: {err_tail}"
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--timeout", type=float,
+                    default=float(os.environ.get("TPU_PROBE_TIMEOUT_S", "60")))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    r = probe(args.timeout)
+    if args.json:
+        print(json.dumps(r))
+    else:
+        print(f"{r['status']}  platform={r['platform']} n={r['n_devices']} "
+              f"elapsed={r['elapsed_s']}s  {r['detail']}")
+    return 0 if r["status"] == "healthy" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

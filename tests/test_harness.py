@@ -1,0 +1,101 @@
+"""Harness plumbing: tpu_probe classification + bench persistence.
+
+These protect the round-1 lesson (VERDICT.md Weak #2): a wedged tunnel at
+end-of-round must degrade to a classified probe status and a persisted
+earlier TPU measurement, not a 420s hang plus a silent CPU number.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import axon_guard  # noqa: E402
+import tpu_probe  # noqa: E402
+
+
+def _cpu_env():
+    return {**os.environ, "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": axon_guard.strip_pythonpath(),
+            "XLA_FLAGS": ""}
+
+
+def test_probe_classifies_cpu_only():
+    r = tpu_probe.probe(timeout=120.0, env=_cpu_env())
+    assert r["status"] == "cpu-only"
+    assert r["platform"] == "cpu"
+    assert r["stages"][-1].startswith("compute-done")
+
+
+def test_probe_classifies_a_hang(monkeypatch):
+    # a child that stalls mid-init must be classified, not waited on forever
+    monkeypatch.setattr(
+        tpu_probe, "_CHILD",
+        'import sys, time\n'
+        'sys.stdout.write("STAGE import-start\\n"); sys.stdout.flush()\n'
+        'sys.stdout.write("STAGE import-done\\n"); sys.stdout.flush()\n'
+        'time.sleep(3600)\n')
+    r = tpu_probe.probe(timeout=3.0, env=_cpu_env())
+    assert r["status"] == "wedged-init"
+    assert r["stages"] == ["import-start", "import-done"]
+
+
+def test_probe_classifies_child_error(monkeypatch):
+    monkeypatch.setattr(
+        tpu_probe, "_CHILD",
+        'import sys\n'
+        'sys.stdout.write("STAGE import-start\\n"); sys.stdout.flush()\n'
+        'raise RuntimeError("pjrt init failed")\n')
+    r = tpu_probe.probe(timeout=30.0, env=_cpu_env())
+    assert r["status"] == "error"
+    assert "pjrt init failed" in r["detail"]
+
+
+def test_probe_cli_json():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpu_probe.py"),
+         "--timeout", "120", "--json"],
+        capture_output=True, text=True, env=_cpu_env(), timeout=180)
+    out = json.loads(r.stdout)
+    assert out["status"] == "cpu-only"
+    assert r.returncode == 1  # healthy (real TPU) is the only rc-0 state
+
+
+def test_bench_persistence_round_trip(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "PERSIST_PATH", str(tmp_path / "tpu_best.json"))
+    key = "packed:default:B3/S23"
+    assert bench._load_persisted(key) is None
+
+    bench._persist_if_best(key, {"metric": "m (axon)", "value": 2e9,
+                                 "unit": "cell-updates/sec", "vs_baseline": 2.0})
+    got = bench._load_persisted(key)
+    assert got["value"] == 2e9
+    assert "recorded_at" in got
+
+    # a worse later measurement must not clobber the best one
+    bench._persist_if_best(key, {"metric": "m (axon)", "value": 1e9,
+                                 "unit": "cell-updates/sec", "vs_baseline": 1.0})
+    assert bench._load_persisted(key)["value"] == 2e9
+
+    # a better one replaces it; other keys are untouched
+    bench._persist_if_best(key, {"metric": "m (axon)", "value": 3e9,
+                                 "unit": "cell-updates/sec", "vs_baseline": 3.0})
+    bench._persist_if_best("sparse:65536:B3/S23", {"metric": "s", "value": 1.0,
+                                                   "unit": "u", "vs_baseline": 0.0})
+    assert bench._load_persisted(key)["value"] == 3e9
+    assert bench._load_persisted("sparse:65536:B3/S23")["value"] == 1.0
+
+
+def test_bench_config_key_uses_requested_size():
+    import bench
+
+    a = bench._parse(["--backend", "packed"])
+    b = bench._parse(["--backend", "packed", "--size", "16384"])
+    assert bench._config_key(a) == "packed:default:B3/S23"
+    assert bench._config_key(b) == "packed:16384:B3/S23"
+    assert bench._config_key(a) != bench._config_key(b)
