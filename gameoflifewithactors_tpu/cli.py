@@ -35,6 +35,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.render == "final":
         ConsoleRenderer(ansi=False)(coordinator.current_frame())
+    elif cfg.track_population:
+        # --population with --render off still reports the number (the
+        # renderer's status line is the only other place it surfaces)
+        frame = coordinator.current_frame()
+        print(f"gen {frame.generation}  pop {frame.population}")
 
     if cfg.checkpoint:
         from .utils import checkpoint as ckpt_lib

@@ -94,7 +94,8 @@ def parse_generations(spec: "str | GenRule") -> GenRule:
     key = spec.strip().lower().replace(" ", "").replace("'", "")
     if key in GENERATIONS_REGISTRY:
         return GENERATIONS_REGISTRY[key]
-    m = _GEN_RE.match(spec.strip())
+    # match the space-stripped key, so 'B2 / S / C3' parses
+    m = _GEN_RE.match(key)
     if not m:
         raise ValueError(
             f"not a Generations rule: {spec!r} (want 'B…/S…/C<n>' or one of "
@@ -117,8 +118,8 @@ def parse_any(spec):
     if isinstance(spec, (Rule, GenRule, LtLRule)):
         return spec
     key = spec.strip().lower().replace(" ", "").replace("'", "")
-    if key in GENERATIONS_REGISTRY or _GEN_RE.match(spec.strip()):
+    if key in GENERATIONS_REGISTRY or _GEN_RE.match(key):
         return parse_generations(spec)
-    if key in LTL_REGISTRY or _LTL_RE.match(spec.strip()):
+    if key in LTL_REGISTRY or _LTL_RE.match(key):
         return parse_ltl(spec)
     return parse_rule(spec)

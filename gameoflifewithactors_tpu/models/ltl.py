@@ -78,7 +78,8 @@ def parse_ltl(spec: "str | LtLRule") -> LtLRule:
     key = spec.strip().lower().replace(" ", "")
     if key in LTL_REGISTRY:
         return LTL_REGISTRY[key]
-    m = _LTL_RE.match(spec.strip())
+    # match the space-stripped key, so 'R5, C0, M1, S34..58, B34..45' parses
+    m = _LTL_RE.match(key)
     if not m:
         raise ValueError(
             f"not a Larger-than-Life rule: {spec!r} (want "

@@ -33,8 +33,10 @@ def save(engine: Engine, path: "str | Path") -> Path:
     multistate = bool(grid.max(initial=0) > 1)  # Generations states
     meta = dict(
         # binary/packbits files keep the v1 stamp (layout unchanged, old
-        # readers still load them); only the multistate layout needs v2
-        version=2 if multistate else 1,
+        # readers still load them); only the multistate layout gets the
+        # current format version, so a future bump propagates from the
+        # constant instead of silently drifting from it
+        version=FORMAT_VERSION if multistate else 1,
         rule=engine.rule.notation,
         topology=engine.topology.value,
         generation=engine.generation,

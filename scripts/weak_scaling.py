@@ -23,10 +23,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)  # allow `python scripts/weak_scaling.py`
 
 
 def main() -> None:
@@ -82,8 +87,11 @@ def main() -> None:
     base = None  # (devices, rate) of the first measured point
     results = []
     for n in counts:
-        nx, ny = mesh_lib.factor2d(n)
-        mesh = mesh_lib.make_mesh((nx, ny), devices[:n])
+        # shape=None delegates to make_mesh's own selection: slice-banded
+        # (factor2d_sliced) when the devices span DCN slices and divide
+        # evenly, plain factor2d otherwise — same policy, same guards
+        mesh = mesh_lib.make_mesh(None, devices[:n])
+        nx, ny = mesh.shape[mesh_lib.ROW_AXIS], mesh.shape[mesh_lib.COL_AXIS]
         H, W = nx * th, ny * tw
         grid = rng.integers(0, 2, size=(H, W), dtype=np.uint8)
         p = mesh_lib.device_put_sharded_grid(

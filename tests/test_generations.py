@@ -44,6 +44,8 @@ def oracle(g: np.ndarray, rule: GenRule, torus: bool, n: int) -> np.ndarray:
 def test_parse_notation_and_names():
     assert parse_generations("B2/S/C3") == BRIANS_BRAIN
     assert parse_generations("b2/s/g3") == BRIANS_BRAIN
+    assert parse_generations("B2 / S / C3") == BRIANS_BRAIN
+    assert parse_any("B2 / S / C3") == BRIANS_BRAIN
     assert parse_generations("brain") == BRIANS_BRAIN
     assert parse_generations("starwars") == STAR_WARS
     assert BRIANS_BRAIN.notation == "B2/S/C3"

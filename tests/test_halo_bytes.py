@@ -1,0 +1,84 @@
+"""Estimate vs compiler-measured halo traffic (VERDICT.md round-1 Weak #5).
+
+``Engine.halo_bytes_per_gen`` is an arithmetic estimate; here it is checked
+against ``measured_halo_bytes_per_gen``, which counts collective-permute
+operand bytes × source→target pairs in the SPMD-partitioned HLO that XLA
+actually compiled for one generation on the 8-fake-device mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.engine import Engine
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+from gameoflifewithactors_tpu.utils.profiling import (
+    collective_permute_bytes,
+    measured_halo_bytes_per_gen,
+)
+
+
+def _mesh(shape):
+    return mesh_lib.make_mesh(shape, jax.devices()[: shape[0] * shape[1]])
+
+
+def _grid(h=128, w=256):
+    return np.random.default_rng(0).integers(0, 2, size=(h, w), dtype=np.uint8)
+
+
+CASES = [
+    # (mesh shape, backend, rule, topology)
+    ((2, 4), "packed", "B3/S23", Topology.TORUS),
+    ((2, 4), "packed", "B3/S23", Topology.DEAD),
+    ((4, 2), "packed", "B3/S23", Topology.TORUS),
+    ((2, 4), "dense", "B3/S23", Topology.TORUS),
+    ((2, 2), "dense", "B3/S23", Topology.DEAD),
+    ((2, 4), "dense", "brain", Topology.TORUS),    # Generations, uint8 path
+    ((2, 2), "dense", "R2,C0,M0,S3..8,B5..7", Topology.TORUS),  # LtL depth 2
+]
+
+
+@pytest.mark.parametrize("shape,backend,rule,topology", CASES,
+                         ids=lambda v: str(v).replace(" ", ""))
+def test_estimate_matches_compiled_hlo(shape, backend, rule, topology):
+    eng = Engine(_grid(), rule=rule, topology=topology, mesh=_mesh(shape),
+                 backend=backend)
+    est = eng.halo_bytes_per_gen()
+    got = measured_halo_bytes_per_gen(eng)
+    assert got > 0, "no collective-permute found in the compiled HLO"
+    assert got == est, (
+        f"halo estimate {est} B/gen != measured {got} B/gen "
+        f"(mesh {shape}, {backend}, {rule}, {topology})")
+
+
+def test_sharded_sparse_includes_flag_traffic():
+    eng = Engine(_grid(), rule="B3/S23", topology=Topology.TORUS,
+                 mesh=_mesh((2, 4)), backend="sparse")
+    est = eng.halo_bytes_per_gen()
+    got = measured_halo_bytes_per_gen(eng)
+    assert got == est, f"sparse halo estimate {est} != measured {got}"
+
+
+def test_unsharded_engine_moves_nothing():
+    eng = Engine(_grid(64, 64), rule="B3/S23")
+    assert eng.halo_bytes_per_gen() == 0
+    assert measured_halo_bytes_per_gen(eng) == 0
+
+
+def test_parser_on_synthetic_hlo():
+    txt = """
+  %x = u32[4]{0} add(%p, %q)
+  %cp.1 = u32[1,8]{1,0} collective-permute(%a), channel_id=1, source_target_pairs={{0,2},{2,0}}
+  %cp.2 = (u8[3,66]{1,0}, u8[3,66]{1,0}, u32[], u32[]) collective-permute-start(%b), source_target_pairs={{1,3}}
+  %done = u8[3,66]{1,0} collective-permute-done(%cp.2)
+"""
+    # cp.1: 32 B x 2 pairs; cp.2 (TPU async tuple form): operand element
+    # 198 B x 1 pair counted once; -done and the add contribute nothing
+    assert collective_permute_bytes(txt) == 32 * 2 + 198
+
+
+def test_parser_rejects_unknown_dtype():
+    txt = "%cp = f8e4m3[8]{0} collective-permute(%a), source_target_pairs={{0,1}}\n"
+    with pytest.raises(ValueError, match="unlisted dtype"):
+        collective_permute_bytes(txt)

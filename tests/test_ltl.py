@@ -36,6 +36,9 @@ def oracle(g: np.ndarray, rule: LtLRule, torus: bool, n: int) -> np.ndarray:
 def test_parse_notation_and_names():
     assert parse_ltl("R5,C0,M1,S34..58,B34..45") == BOSCO
     assert parse_ltl("bosco") == BOSCO
+    # internal whitespace is normalized for notation too, not just names
+    assert parse_ltl("R5, C0, M1, S34..58, B34..45") == BOSCO
+    assert parse_any("R5, C0, M1, S34..58, B34..45") == BOSCO
     assert BOSCO.notation == "R5,C0,M1,S34..58,B34..45"
     assert parse_any("bosco") == BOSCO
     assert isinstance(parse_any("R2,C0,M0,S3..8,B5..7"), LtLRule)

@@ -25,10 +25,16 @@ GENS = 100
 def _rate(run, state) -> float:
     state = run(state, 10)  # compile + warm
     state.block_until_ready()
-    t0 = time.perf_counter()
-    out = run(state, GENS)
-    out.block_until_ready()
-    return SIDE * SIDE * GENS / (time.perf_counter() - t0)
+    # best-of-3 (bench.py's pattern): a background process landing on one
+    # timed region must not flip the packed-vs-dense ratio on this shared
+    # 1-vCPU host
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state = run(state, GENS)
+        state.block_until_ready()
+        best = max(best, SIDE * SIDE * GENS / (time.perf_counter() - t0))
+    return best
 
 
 def test_packed_rate_floor_and_packing_advantage():
