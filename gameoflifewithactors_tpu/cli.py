@@ -35,9 +35,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.render == "final":
         ConsoleRenderer(ansi=False)(coordinator.current_frame())
-    elif cfg.track_population:
-        # --population with --render off still reports the number (the
-        # renderer's status line is the only other place it surfaces)
+    elif args.render == "off" and cfg.track_population:
+        # --population with --render off still reports the number (live and
+        # final rendering already show it in the status line)
         frame = coordinator.current_frame()
         print(f"gen {frame.generation}  pop {frame.population}")
 

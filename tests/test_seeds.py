@@ -46,3 +46,18 @@ def test_bernoulli_fill():
     frac = float(np.asarray(g).mean())
     assert 0.45 < frac < 0.55
     assert g.dtype == jax.numpy.uint8
+
+
+def test_seeded_packed_matches_dense_seeding():
+    from gameoflifewithactors_tpu.ops import bitpack
+
+    dense = seeds.seeded((64, 128), "gosper_gun", 5, 32)  # col 32 = word 1
+    packed = seeds.seeded_packed((64, 128), "gosper_gun", top=5, left_word=1)
+    np.testing.assert_array_equal(packed, bitpack.pack_np(dense))
+
+
+def test_seeded_packed_validates():
+    with pytest.raises(ValueError, match="not a multiple"):
+        seeds.seeded_packed((64, 100), "glider")
+    with pytest.raises(ValueError, match="exceeds"):
+        seeds.seeded_packed((8, 32), "gosper_gun")

@@ -214,3 +214,25 @@ class TestShardedSparse:
         row_sends, col_sends = 2 * 4 * 2, 2 * 2 * 4
         assert (e.halo_bytes_per_gen() - plain.halo_bytes_per_gen()
                 == row_sends * 4 + col_sends * 12)
+
+
+def test_sparse_at_scale_8192():
+    """VERDICT round-1 Missing #4: config #5's shape exercised at >=8192².
+
+    Word-aligned small-patch seeding (as scripts/config5_sparse.py does at
+    65536²), 64 generations, bit-identity against the dense packed step on
+    the full 8192² grid, and the sparse invariant: compute stayed ∝ the
+    gun's footprint (a handful of active tiles out of 16k), not the grid.
+    """
+    side = 8192
+    words = side // 32
+    grid = seeds.seeded_packed((side, side), "gosper_gun",
+                               top=side // 2, left_word=words // 2)
+
+    s = SparseEngineState(jnp.asarray(grid), CONWAY)
+    s.step(64)
+    want = multi_step_packed(jnp.asarray(grid), 64, rule=CONWAY,
+                             topology=Topology.DEAD)
+    np.testing.assert_array_equal(np.asarray(s.packed), np.asarray(want))
+    assert s.active_tiles() <= 8
+    assert s.active_tiles() < (side // s.tile_rows) * (words // s.tile_words) // 1000
