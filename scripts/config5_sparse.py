@@ -91,8 +91,7 @@ def main() -> None:
         best = max(best, args.gens / (time.perf_counter() - t0))
 
     gens_done = 4 + args.repeats * args.gens
-    pop = int(jnp.sum(jax.vmap(lambda r: jnp.sum(
-        jax.lax.population_count(r)))(state.packed)))
+    pop = bitpack.population(state.packed)
     summary = {
         "metric": f"gens/sec, {side}x{side} Gosper gun (sparse, {platform})",
         "value": best,

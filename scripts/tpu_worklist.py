@@ -1,0 +1,319 @@
+"""Run the round's TPU agenda during a healthy tunnel window.
+
+VERDICT.md round-1 items #2/#3/#5: every TPU-specific claim (north-star
+bench, native Pallas, LtL-on-MXU, Generations, sparse at scale) needs
+evidence from the real chip. The tunnel wedges intermittently, so this
+orchestrator: probes first (scripts/tpu_probe.py), runs each agenda item
+in its own watchdog subprocess, and merges results into
+``results/tpu_worklist.json`` after *each* item — a wedge mid-list keeps
+everything already measured. Safe to re-run; better numbers replace worse.
+
+  python scripts/tpu_worklist.py            # probe, then run all items
+  python scripts/tpu_worklist.py --items pallas_identity,bench_packed
+  python scripts/tpu_worklist.py --force    # skip the probe gate
+
+Items:
+  bench_packed      north-star: bench.py packed @16384² (persists best)
+  pallas_identity   native-Mosaic kernel bit-identity vs XLA SWAR on-chip
+  pallas_autotune   sweep (block_rows, gens_per_call), record best rate
+  ltl_bosco         LtL bf16-conv path: on-chip bit-identity vs CPU + rate
+  generations_brain Generations path: on-chip bit-identity vs CPU + rate
+  ltl_mxu_hlo       compiled-HLO evidence the LtL conv lowers to bf16 conv
+  config5_sparse    65536² Gosper gun sparse on the chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_REPO, os.path.dirname(os.path.abspath(__file__))):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+OUT_PATH = os.path.join(_REPO, "results", "tpu_worklist.json")
+WATCHDOG_S = float(os.environ.get("WORKLIST_WATCHDOG_S", "600"))
+
+
+# ---------------------------------------------------------------------------
+# child bodies (run on the real chip; parent enforces the watchdog)
+# ---------------------------------------------------------------------------
+
+def _sync_scalar(x):
+    """Completion proof on the tunnel: block_until_ready is a no-op there,
+    only a data-dependent scalar readback shows the chip really finished."""
+    import jax.numpy as jnp
+
+    return int(jnp.sum(x.astype(jnp.uint32))) & 0xFFFF
+
+
+def _device_equal(a, b) -> bool:
+    """Compare ON device — full-array fetches can fail on the tunnel where
+    scalar-reduction fetches succeed."""
+    import jax.numpy as jnp
+
+    return bool(jnp.array_equal(a, b))
+
+
+def child_bench_packed() -> dict:
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--no-probe"],
+        capture_output=True, text=True, timeout=WATCHDOG_S)
+    line = next((ln for ln in reversed(r.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if r.returncode or line is None:
+        return {"ok": False, "detail": (r.stderr or r.stdout)[-800:]}
+    return {"ok": True, **json.loads(line)}
+
+
+def child_pallas_identity() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.pallas_stencil import multi_step_pallas, supported
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(7)
+    out = {"platform": platform, "cases": []}
+    for (h, w) in ((512, 4096), (1024, 8192)):
+        grid = rng.integers(0, 2 ** 32, size=(h, w // 32), dtype=np.uint32)
+        p = jnp.asarray(grid)
+        assert supported(p.shape, on_tpu=True)
+        for topology in (Topology.TORUS, Topology.DEAD):
+            for gens in (1, 8, 23):
+                want = multi_step_packed(p, gens, rule=CONWAY, topology=topology)
+                got = multi_step_pallas(p, gens, rule=CONWAY, topology=topology,
+                                        interpret=False)
+                same = _device_equal(got, want)
+                out["cases"].append({"shape": [h, w], "topology": topology.value,
+                                     "gens": gens, "bit_identical": same})
+                if not same:
+                    out["ok"] = False
+                    return out
+    out["ok"] = True
+    return out
+
+
+def child_pallas_autotune() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.pallas_stencil import multi_step_pallas
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    side = 16384
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(side, side // 32), dtype=np.uint32))
+    results, best = [], None
+    for bh in (128, 256, 512, 1024):
+        for g in (4, 8, 16, 32):
+            if g > bh:
+                continue
+            try:
+                run = lambda s, n: multi_step_pallas(
+                    s, n, rule=CONWAY, topology=Topology.TORUS,
+                    block_rows=bh, gens_per_call=g, interpret=False)
+                q = run(p, g)      # compile + warm (one full kernel call)
+                _sync_scalar(q)
+                gens = 4 * g
+                t0 = time.perf_counter()
+                q = run(q, gens)
+                _sync_scalar(q)
+                rate = side * side * gens / (time.perf_counter() - t0)
+                rec = {"block_rows": bh, "gens_per_call": g, "rate": rate}
+                results.append(rec)
+                if best is None or rate > best["rate"]:
+                    best = rec
+            except Exception as e:  # Mosaic may reject some configs
+                results.append({"block_rows": bh, "gens_per_call": g,
+                                "error": str(e)[:300]})
+    return {"ok": best is not None, "best": best, "sweep": results,
+            "platform": jax.devices()[0].platform}
+
+
+def _rule_child(rule_name: str, side: int) -> dict:
+    """On-chip bit-identity vs the CPU backend + measured rate (dense path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.models.ltl import LtLRule
+    from gameoflifewithactors_tpu.ops.generations import multi_step_generations
+    from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    rule = parse_any(rule_name)
+    n_states = getattr(rule, "states", 2)
+    run = (multi_step_ltl if isinstance(rule, LtLRule) else multi_step_generations)
+    rng = np.random.default_rng(3)
+    dev = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+
+    # bit-identity on a small grid: same program, chip vs host CPU backend
+    small = rng.integers(0, n_states, size=(256, 256), dtype=np.uint8)
+    with jax.default_device(cpu):
+        want = run(jnp.asarray(small), 16, rule=rule, topology=Topology.TORUS)
+    got = run(jax.device_put(jnp.asarray(small), dev), 16, rule=rule,
+              topology=Topology.TORUS)
+    identical = _device_equal(got, jax.device_put(want, dev))
+
+    big = jnp.asarray(rng.integers(0, n_states, size=(side, side), dtype=np.uint8))
+    s = run(big, 4, rule=rule, topology=Topology.TORUS)
+    _sync_scalar(s)
+    gens = 32
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        s = run(s, gens, rule=rule, topology=Topology.TORUS)
+        _sync_scalar(s)
+        best = max(best, side * side * gens / (time.perf_counter() - t0))
+    return {"ok": identical, "bit_identical_vs_cpu": identical,
+            "rule": rule.notation, "side": side,
+            "cell_updates_per_sec": best, "platform": dev.platform}
+
+
+def child_ltl_bosco() -> dict:
+    return _rule_child("bosco", 4096)
+
+
+def child_generations_brain() -> dict:
+    return _rule_child("brain", 4096)
+
+
+def child_ltl_mxu_hlo() -> dict:
+    """Static evidence for the MXU claim: the compiled LtL step must contain
+    a convolution whose operands lowered to bf16 (ops/ltl.py routes the
+    radius-r neighbor count through lax.conv in bf16 on TPU)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.ltl import parse_ltl
+    from gameoflifewithactors_tpu.ops.ltl import step_ltl
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    rule = parse_ltl("bosco")
+    g = jnp.asarray(np.zeros((512, 512), dtype=np.uint8))
+    txt = (jax.jit(lambda x: step_ltl(x, rule=rule, topology=Topology.TORUS))
+           .lower(g).compile().as_text())
+    convs = re.findall(r"= *\S+ (?:convolution|conv)\b[^\n]*", txt)
+    bf16 = [c for c in convs if "bf16" in c]
+    return {"ok": bool(bf16), "n_convolutions": len(convs),
+            "n_bf16_convolutions": len(bf16),
+            "sample": (bf16 or convs or ["<none>"])[0][:300],
+            "platform": jax.devices()[0].platform}
+
+
+def child_config5_sparse() -> dict:
+    out_path = os.path.join(_REPO, "results", "config5_sparse_65536_tpu.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "config5_sparse.py"),
+         "--gens", "256", "--repeats", "2", "--out", out_path],
+        capture_output=True, text=True, timeout=WATCHDOG_S)
+    line = next((ln for ln in reversed(r.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if r.returncode or line is None:
+        return {"ok": False, "detail": (r.stderr or r.stdout)[-800:]}
+    return {"ok": True, **json.loads(line)}
+
+
+ITEMS = {
+    "bench_packed": child_bench_packed,
+    "pallas_identity": child_pallas_identity,
+    "pallas_autotune": child_pallas_autotune,
+    "ltl_bosco": child_ltl_bosco,
+    "generations_brain": child_generations_brain,
+    "ltl_mxu_hlo": child_ltl_mxu_hlo,
+    "config5_sparse": child_config5_sparse,
+}
+
+# bench_packed / config5_sparse already run their body in a subprocess of
+# their own; the rest run jax in THIS process when invoked with --item
+_INPROC_ITEMS = [k for k in ITEMS if k not in ("bench_packed", "config5_sparse")]
+
+
+def _merge(item: str, result: dict) -> None:
+    try:
+        with open(OUT_PATH) as f:
+            store = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        store = {}
+    prev = store.get(item)
+    # keep a previous ok result over a new failure; otherwise replace
+    if not (prev and prev.get("ok") and not result.get("ok")):
+        store[item] = {**result,
+                       "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    tmp = OUT_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(store, f, indent=1)
+    os.replace(tmp, OUT_PATH)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--items", default=",".join(ITEMS))
+    ap.add_argument("--force", action="store_true", help="skip the probe gate")
+    ap.add_argument("--item", help=argparse.SUPPRESS)  # child mode
+    args = ap.parse_args()
+
+    if args.item:
+        print(json.dumps(ITEMS[args.item]()))
+        return 0
+
+    if not args.force:
+        from tpu_probe import probe
+
+        health = probe(timeout=float(os.environ.get("TPU_PROBE_TIMEOUT_S", "60")))
+        print(f"tpu_probe: {health['status']} ({health['detail']})", file=sys.stderr)
+        if health["status"] != "healthy":
+            print(json.dumps({"skipped": True, "probe": health}))
+            return 1
+
+    failures = 0
+    for item in args.items.split(","):
+        item = item.strip()
+        if item not in ITEMS:
+            raise SystemExit(f"unknown item {item!r}; know {sorted(ITEMS)}")
+        t0 = time.time()
+        if item in _INPROC_ITEMS:
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--item", item],
+                    capture_output=True, text=True, timeout=WATCHDOG_S)
+                line = next((ln for ln in reversed(r.stdout.strip().splitlines())
+                             if ln.startswith("{")), None)
+                result = (json.loads(line) if r.returncode == 0 and line
+                          else {"ok": False, "detail": (r.stderr or r.stdout)[-800:]})
+            except subprocess.TimeoutExpired:
+                result = {"ok": False, "detail": f"hung >{WATCHDOG_S}s (wedged?)"}
+        else:
+            try:
+                result = ITEMS[item]()
+            except subprocess.TimeoutExpired:
+                result = {"ok": False, "detail": f"hung >{WATCHDOG_S}s (wedged?)"}
+        result["elapsed_s"] = round(time.time() - t0, 1)
+        _merge(item, result)
+        print(f"{item}: {'ok' if result.get('ok') else 'FAILED'} "
+              f"({result['elapsed_s']}s)", file=sys.stderr)
+        failures += 0 if result.get("ok") else 1
+    print(json.dumps({"done": True, "failures": failures, "out": OUT_PATH}))
+    return 0 if failures == 0 else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
