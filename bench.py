@@ -146,7 +146,9 @@ def run_bench(args) -> None:
     elif args.backend == "sparse":
         from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
 
-        sparse_state = SparseEngineState(jnp.asarray(bitpack.pack_np(np.asarray(grid))), rule)
+        sparse_state = SparseEngineState(
+            jnp.asarray(bitpack.pack_np(np.asarray(grid))), rule,
+            topology=Topology.TORUS)  # same boundary as the other backends
 
         def run(s, n):
             sparse_state.step(int(n))

@@ -8,7 +8,8 @@ sharded 2D over a mesh. Everything host-facing (rendering, scheduling,
 checkpointing) talks to the engine through :meth:`snapshot`/:meth:`step`,
 keeping device round-trips off the hot loop: ``step`` only *dispatches*
 work (JAX async dispatch pipelines generations); data comes back only when
-snapshot/population are explicitly asked for.
+snapshot/population are explicitly asked for. (Exception: the sparse
+backend fetches one scalar per step() call — see Engine.step.)
 """
 
 from __future__ import annotations
@@ -49,9 +50,9 @@ class Engine:
         kernel advancing several generations per HBM round-trip;
         single-device only — the sharded engines use the packed path), or
         "sparse" (activity-tiled: compute scales with changed area, for
-        huge mostly-empty universes; single-device form is DEAD-only,
-        with a mesh it shards with per-device activity skipping and
-        supports both topologies).
+        huge mostly-empty universes; both topologies on one device —
+        torus refreshes the halo ring with wrapped edges each generation
+        — and with a mesh it shards with per-device activity skipping).
     """
 
     def __init__(
@@ -90,12 +91,6 @@ class Engine:
                         and not (self._generations or self._ltl))
         self._sparse = None
         self._flags = None
-        if backend == "sparse" and mesh is None and topology is not Topology.DEAD:
-            raise ValueError(
-                "single-device backend='sparse' supports Topology.DEAD only "
-                "(its zero ring is the boundary); use 'packed' for torus "
-                "grids, or add a mesh (the sharded sparse path handles torus)"
-            )
         if mesh is not None:
             if backend == "pallas":
                 raise ValueError(
@@ -170,7 +165,8 @@ class Engine:
                     f"{tr} x {bitpack.WORD * tw} cells; pass sparse_opts="
                     f"dict(tile_rows=..., tile_words=...) that divide it"
                 )
-            self._sparse = SparseEngineState(state, self.rule, **opts)
+            self._sparse = SparseEngineState(
+                state, self.rule, topology=topology, **opts)
             self._run = None  # step() routes through the sparse state
             state = None  # the padded copy inside _sparse is the state now
         elif backend == "pallas":
@@ -215,7 +211,12 @@ class Engine:
     # -- stepping ------------------------------------------------------------
 
     def step(self, n: int = 1) -> None:
-        """Advance ``n`` generations (dispatches async; does not block)."""
+        """Advance ``n`` generations.
+
+        Dense/packed/pallas backends dispatch async (no block). The sparse
+        backend reads one scalar per call (generations completed by its
+        on-device loop — the price of its copy-free overflow design), so
+        it synchronizes with the device once per step() call."""
         if n < 0:
             raise ValueError(f"cannot step a negative number of generations: {n}")
         if n == 0:
@@ -320,6 +321,7 @@ class Engine:
                 tile_rows=self._sparse.tile_rows,
                 tile_words=self._sparse.tile_words,
                 capacity=self._sparse.capacity,
+                topology=self._sparse.topology,
             )
         else:
             self._state = state

@@ -17,11 +17,15 @@ flags … rather than a true sparse format, which stays XLA-friendly"):
   ``jnp.nonzero(..., size=K)`` (static shapes: no recompilation), steps
   them as a vmapped batch of (T+2-row, Tw+2-word) windows, and scatters
   the interiors back;
-- if more than K tiles are active, the generation falls back to a full
-  dense step under ``lax.cond`` — correctness never depends on K.
+- if more than K tiles are active, the on-device loop exits early and the
+  host dispatches one full-grid dense generation, then resumes sparse —
+  correctness never depends on K (see _build_sparse_step for why this
+  beats the earlier per-generation ``lax.cond`` design).
 
-v1 is single-device and DEAD-topology (the zero ring *is* the boundary);
-a torus needs ring maintenance and is left to the dense/sharded paths.
+Single-device, both topologies: for DEAD the zero ring *is* the boundary;
+for TORUS the ring is refreshed with wrapped interior edges every
+generation and the activity dilation wraps (seam-crossing ships work).
+The sharded form lives in parallel/sharded.py.
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ import jax.numpy as jnp
 
 from ..models.rules import Rule
 from .packed import step_packed_ext
+from .stencil import Topology
 
 DEFAULT_TILE_ROWS = 32
 DEFAULT_TILE_WORDS = 4
@@ -57,12 +62,33 @@ def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int) -> jax.
     return (tiles != 0).any(axis=(1, 3))
 
 
-def _dilate(active: jax.Array) -> jax.Array:
-    """3×3 tile-neighborhood OR — which tiles must be stepped."""
+def _dilate(active: jax.Array, wrap: bool = False) -> jax.Array:
+    """3×3 tile-neighborhood OR — which tiles must be stepped.
+
+    ``wrap`` makes the neighborhood toroidal: an edge tile's change wakes
+    the opposite-edge tile (a glider crossing the seam must find its
+    destination awake)."""
     a = active
-    a = a | jnp.pad(active, ((1, 0), (0, 0)))[:-1, :] | jnp.pad(active, ((0, 1), (0, 0)))[1:, :]
-    a = a | jnp.pad(a, ((0, 0), (1, 0)))[:, :-1] | jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
+    if wrap:
+        a = a | jnp.roll(active, 1, 0) | jnp.roll(active, -1, 0)
+        a = a | jnp.roll(a, 1, 1) | jnp.roll(a, -1, 1)
+    else:
+        a = a | jnp.pad(active, ((1, 0), (0, 0)))[:-1, :] | jnp.pad(active, ((0, 1), (0, 0)))[1:, :]
+        a = a | jnp.pad(a, ((0, 0), (1, 0)))[:, :-1] | jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
     return a
+
+
+def _refresh_ring(padded: jax.Array) -> jax.Array:
+    """Torus: the one-word/one-row ring holds wrapped copies of the opposite
+    interior edges (incl. corners), refreshed every generation so edge tiles
+    see current cross-seam neighbors. O(H + Wp) words per generation."""
+    inter = padded[1:-1, 1:-1]
+    padded = padded.at[0, 1:-1].set(inter[-1])
+    padded = padded.at[-1, 1:-1].set(inter[0])
+    padded = padded.at[1:-1, 0].set(inter[:, -1])
+    padded = padded.at[1:-1, -1].set(inter[:, 0])
+    corners = jnp.stack([inter[-1, -1], inter[-1, 0], inter[0, -1], inter[0, 0]])
+    return padded.at[(0, 0, -1, -1), (0, -1, 0, -1)].set(corners)
 
 
 @lru_cache(maxsize=32)
@@ -72,16 +98,28 @@ def _build_sparse_step(
     tile_rows: int,
     tile_words: int,
     capacity: int,
+    topology: Topology = Topology.DEAD,
 ):
-    """Jitted (padded, active, n) -> (padded, active) n-generation step.
+    """Build the jitted (sparse_many, dense_once) pair for this config.
 
-    The generation loop is an on-device ``fori_loop`` and the state buffers
-    are donated: per-call cost is one dispatch for any ``n``, and XLA can
-    update the (potentially ~0.5 GB at 65536²) padded grid in place instead
-    of materializing a copy per generation.
+    DEAD: the zero ring *is* the boundary. TORUS: the ring is refreshed
+    with wrapped interior edges each generation (same whole-word halo
+    mechanism as the sharded path's ppermute strips) and tile-activity
+    dilation wraps, so seam-crossing ships work.
+
+    Returns ``(sparse_many, dense_once)``; SparseEngineState.step
+    orchestrates them. The common all-sparse case runs entirely on-device
+    in a ``while_loop`` that early-exits when the candidate count exceeds
+    ``capacity``; the host then dispatches one ``dense_once`` generation
+    and resumes. The loop body is scatter-only, so XLA updates the
+    (~0.5 GB at 65536²) grid in place — the earlier design's
+    ``lax.cond(sparse, dense)`` per generation blocked output aliasing
+    and paid a full-buffer copy every generation (measured 45 ms/gen vs
+    3 ms/gen at 32768² on CPU; VERDICT.md round-1 Weak #6).
     """
     H, Wp = shape
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+    wrap = topology is Topology.TORUS
 
     def gather_window(padded, ty, tx):
         # window = tile + 1 halo ring; padded grid offset makes this exact
@@ -90,56 +128,74 @@ def _build_sparse_step(
             (tile_rows + 2, tile_words + 2),
         )
 
-    def sparse_path(padded, candidates):
+    def sparse_gen(padded, candidates, n_cand):
+        if wrap:
+            padded = _refresh_ring(padded)
         idx = jnp.nonzero(candidates.ravel(), size=capacity, fill_value=0)[0]
-        valid = jnp.arange(capacity) < jnp.sum(candidates)
+        valid = jnp.arange(capacity) < n_cand
         tys, txs = idx // ntx, idx % ntx
         windows = jax.vmap(lambda ty, tx: gather_window(padded, ty, tx))(tys, txs)
         stepped = jax.vmap(lambda w: step_packed_ext(w, rule))(windows)
         olds = windows[:, 1:-1, 1:-1]
         changed_any = jnp.logical_and((stepped != olds).any(axis=(1, 2)), valid)
 
-        def scatter_one(k, carry):
-            # invalid (fill) slots alias tile 0 and must not touch state —
-            # writing where(valid, ...) would clobber a real tile's fresh
-            # content with its gathered-old copy
-            def do(carry):
-                padded_c, active_c = carry
-                ty, tx = tys[k], txs[k]
-                padded_c = jax.lax.dynamic_update_slice(
-                    padded_c, stepped[k], (ty * tile_rows + 1, tx * tile_words + 1)
-                )
-                return padded_c, active_c.at[ty, tx].set(changed_any[k])
-
-            return jax.lax.cond(valid[k], do, lambda c: c, carry)
-
-        active0 = jnp.zeros((nty, ntx), dtype=bool)
-        padded, active = jax.lax.fori_loop(
-            0, capacity, scatter_one, (padded, active0)
-        )
+        # ONE batched scatter for all tiles (vs. a capacity-long serial
+        # chain of dynamic_update_slice). Invalid (fill) slots alias tile 0
+        # and must not touch state: they are routed out of bounds and
+        # dropped; the remaining indices are distinct tiles, so
+        # unique_indices is safe.
+        row0 = jnp.where(valid, tys * tile_rows + 1, H + 2)
+        col0 = jnp.where(valid, txs * tile_words + 1, Wp + 2)
+        rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
+        cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
+        padded = padded.at[rows, cols].set(stepped, mode="drop",
+                                           unique_indices=True)
+        active = jnp.zeros((nty, ntx), dtype=bool)
+        active = active.at[jnp.where(valid, tys, nty),
+                           jnp.where(valid, txs, ntx)].set(
+            changed_any, mode="drop", unique_indices=True)
         return padded, active
 
-    def dense_path(padded, _candidates):
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def sparse_many(padded, active, n):
+        """Run up to ``n`` generations on-device; stop early at the first
+        generation whose candidate set exceeds capacity. Returns
+        (padded, active, generations_actually_done)."""
+
+        def carry_of(padded, active, i):
+            cand = _dilate(active, wrap)
+            return padded, active, cand, jnp.sum(cand), i
+
+        def cond_fn(c):
+            _, _, _, n_cand, i = c
+            return (i < n) & (n_cand <= capacity)
+
+        def body(c):
+            padded, _, cand, n_cand, i = c
+            padded, active = sparse_gen(padded, cand, n_cand)
+            return carry_of(padded, active, i + 1)
+
+        padded, active, _, _, done = jax.lax.while_loop(
+            cond_fn, body, carry_of(padded, active, 0))
+        return padded, active, done
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def dense_once(padded):
+        """One full-grid generation (the overflow fallback — already O(grid),
+        so the cond-free structure costs nothing extra here)."""
+        if wrap:
+            padded = _refresh_ring(padded)
         old = padded[1:-1, 1:-1]
-        # the zero ring is the DEAD boundary: step the interior against it
+        # step the interior against the ring (zero = DEAD boundary;
+        # wrapped copies = torus)
         new = step_packed_ext(padded, rule)
-        padded = jax.lax.dynamic_update_slice(padded, new, (1, 1))
         tiles_old = old.reshape(nty, tile_rows, ntx, tile_words)
         tiles_new = new.reshape(nty, tile_rows, ntx, tile_words)
-        return padded, (tiles_old != tiles_new).any(axis=(1, 3))
+        changed = (tiles_old != tiles_new).any(axis=(1, 3))
+        padded = jax.lax.dynamic_update_slice(padded, new, (1, 1))
+        return padded, changed
 
-    def one_gen(padded, active):
-        candidates = _dilate(active)
-        n_cand = jnp.sum(candidates)
-        return jax.lax.cond(
-            n_cand <= capacity, sparse_path, dense_path, padded, candidates
-        )
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(padded, active, n):
-        return jax.lax.fori_loop(0, n, lambda _, c: one_gen(*c), (padded, active))
-
-    return step
+    return sparse_many, dense_once
 
 
 class SparseEngineState:
@@ -153,6 +209,7 @@ class SparseEngineState:
         tile_rows: int = DEFAULT_TILE_ROWS,
         tile_words: int = DEFAULT_TILE_WORDS,
         capacity: int = DEFAULT_CAPACITY,
+        topology: Topology = Topology.DEAD,
     ):
         H, Wp = packed.shape
         _tile_grid_shape(H, Wp, tile_rows, tile_words)  # validate
@@ -166,17 +223,29 @@ class SparseEngineState:
         self.tile_rows = tile_rows
         self.tile_words = tile_words
         self.capacity = capacity
+        self.topology = topology
         self.shape = (H, Wp)
         self.padded = jnp.pad(packed, 1)
         self.active = initial_activity(self.padded, tile_rows, tile_words)
-        self._step = _build_sparse_step(
-            rule, (H, Wp), tile_rows, tile_words, capacity
+        self._sparse_many, self._dense_once = _build_sparse_step(
+            rule, (H, Wp), tile_rows, tile_words, capacity, topology
         )
 
     def step(self, n: int = 1) -> None:
-        if n <= 0:
-            return
-        self.padded, self.active = self._step(self.padded, self.active, n)
+        """Advance ``n`` generations: the on-device while_loop runs sparse
+        generations until done or a capacity overflow; overflows fall back
+        to one dense full-grid generation and resume. The host reads one
+        scalar (generations completed) per dispatch — the price of keeping
+        the common path copy-free; all-sparse runs cost exactly one
+        dispatch + one scalar fetch regardless of ``n``."""
+        remaining = int(n)
+        while remaining > 0:
+            self.padded, self.active, done = self._sparse_many(
+                self.padded, self.active, remaining)
+            remaining -= int(done)
+            if remaining > 0:
+                self.padded, self.active = self._dense_once(self.padded)
+                remaining -= 1
 
     @property
     def packed(self) -> jax.Array:

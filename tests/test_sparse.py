@@ -76,8 +76,12 @@ def test_engine_sparse_backend():
     e.step(40)
     np.testing.assert_array_equal(e.snapshot(), _dense_reference(g, 40))
     assert e.population() == 5
-    with pytest.raises(ValueError, match="DEAD"):
-        Engine(g, "conway", backend="sparse")  # default torus rejected
+    # default torus is supported too (ring refresh); glider wraps the seam
+    et = Engine(g, "conway", backend="sparse")
+    et.step(40)
+    want = bitpack.unpack(multi_step_packed(
+        bitpack.pack(jnp.asarray(g)), 40, rule=CONWAY, topology=Topology.TORUS))
+    np.testing.assert_array_equal(et.snapshot(), np.asarray(want))
 
 
 def test_sparse_rejects_b0_rules():
@@ -236,3 +240,48 @@ def test_sparse_at_scale_8192():
     np.testing.assert_array_equal(np.asarray(s.packed), np.asarray(want))
     assert s.active_tiles() <= 8
     assert s.active_tiles() < (side // s.tile_rows) * (words // s.tile_words) // 1000
+
+
+def _torus_reference(grid, n):
+    p = bitpack.pack(jnp.asarray(grid))
+    return np.asarray(
+        bitpack.unpack(multi_step_packed(p, n, rule=CONWAY, topology=Topology.TORUS))
+    )
+
+
+def _sparse_torus(grid, n, **kw):
+    s = SparseEngineState(bitpack.pack(jnp.asarray(grid)), CONWAY,
+                          topology=Topology.TORUS, **kw)
+    s.step(n)
+    return np.asarray(bitpack.unpack(s.packed)), s
+
+
+@pytest.mark.parametrize("top,left", [(2, 118), (58, 4), (58, 118), (2, 4)],
+                         ids=["east-seam", "south-seam", "corner", "interior"])
+def test_sparse_torus_glider_crosses_seams(top, left):
+    """The glider must wrap every seam bit-identically to the packed torus
+    step, and the sparse invariant must hold: the traveling ship keeps only
+    a few tiles awake while crossing."""
+    g = seeds.seeded((64, 128), "glider", top, left)
+    for gens in (16, 64, 180):
+        got, s = _sparse_torus(g, gens, tile_rows=16, tile_words=1, capacity=24)
+        np.testing.assert_array_equal(got, _torus_reference(g, gens),
+                                      err_msg=f"gens={gens}")
+        assert s.active_tiles() <= 6
+
+
+def test_sparse_torus_still_life_sleeps_on_seam():
+    # a block straddling the corner seam is a still life ON THE TORUS —
+    # after one generation everything must fall asleep
+    g = np.zeros((64, 128), dtype=np.uint8)
+    g[0, 0] = g[0, -1] = g[-1, 0] = g[-1, -1] = 1  # 2x2 block across corners
+    got, s = _sparse_torus(g, 8, tile_rows=16, tile_words=1, capacity=24)
+    np.testing.assert_array_equal(got, _torus_reference(g, 8))
+    assert s.active_tiles() == 0
+
+
+def test_sparse_torus_capacity_overflow_dense_fallback():
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)  # everything awake
+    got, _ = _sparse_torus(g, 12, tile_rows=16, tile_words=1, capacity=4)
+    np.testing.assert_array_equal(got, _torus_reference(g, 12))
