@@ -1,0 +1,61 @@
+"""Worker for tests/test_multihost.py: one process of the distributed rig.
+
+Invoked as: python multihost_worker.py <process_id> <num_processes> <port>.
+Each process owns 2 virtual CPU devices; together they form a (2, N) global
+mesh stepping a torus-sharded grid whose glider crosses process boundaries.
+Prints MULTIHOST-OK on bit-identity with the single-device engine.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import axon_guard  # noqa: E402
+
+axon_guard.strip_import_path()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    pid, n_procs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    from gameoflifewithactors_tpu.models import seeds
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+    from gameoflifewithactors_tpu.parallel import multihost, sharded
+
+    multihost.initialize(f"localhost:{port}", n_procs, pid)
+    assert jax.process_count() == n_procs
+    assert len(jax.devices()) == 2 * n_procs
+
+    mesh = multihost.global_mesh((2, n_procs))
+    gens = 120
+    grid = seeds.seeded((64, 64 * n_procs), "glider", 1, 1)
+    packed = bitpack.pack_np(grid)
+
+    p = multihost.put_global_grid(packed, mesh)
+    run = sharded.make_multi_step_packed(mesh, CONWAY, Topology.TORUS)
+    out = run(p, gens)
+    got = multihost.gather_global(out)
+
+    want = np.asarray(multi_step_packed(
+        jnp.asarray(packed), gens, rule=CONWAY, topology=Topology.TORUS))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() > 0  # the glider is alive somewhere
+    print(f"MULTIHOST-OK proc={pid}/{n_procs} devices={len(jax.devices())}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

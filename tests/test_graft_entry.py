@@ -1,0 +1,65 @@
+"""Driver-contract regression tests for __graft_entry__.py.
+
+Round 1's MULTICHIP artifact recorded rc=124 because dryrun_multichip let
+computation fall onto the default (wedged-tunnel) backend. These tests run
+the entry points exactly as the driver does — fresh subprocess, virtual-CPU
+device count forced via env — and must stay green regardless of tunnel
+state. A generous timeout stands in for the driver's watchdog: a hang here
+IS the round-1 bug.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, env_extra: dict, timeout: float = 240.0):
+    env = {**os.environ, **env_extra}
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_dryrun_multichip_driver_style():
+    # as the driver invokes it: its own env staging, 8 virtual CPU devices
+    r = _run(
+        "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('OK')",
+        {"XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+         "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout
+
+
+def test_dryrun_multichip_bare_env():
+    # no driver staging at all — the entry point must stage everything itself
+    r = _run(
+        "from __graft_entry__ import dryrun_multichip; dryrun_multichip(4); print('OK')",
+        {})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout
+
+
+def test_standalone_self_test():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py")],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "entry():" in r.stdout and "dryrun_multichip(8): ok" in r.stdout
+
+
+def test_dryrun_leaves_env_clean_for_children():
+    # VERDICT round-1 Weak #2 regression: after dryrun, a child process must
+    # not inherit a CPU pin (a driver running bench.py next would silently
+    # record a CPU number as TPU evidence)
+    r = _run(
+        "import os\n"
+        "before = {k: os.environ.get(k) for k in ('JAX_PLATFORMS', 'XLA_FLAGS')}\n"
+        "from __graft_entry__ import dryrun_multichip\n"
+        "dryrun_multichip(2)\n"
+        "after = {k: os.environ.get(k) for k in ('JAX_PLATFORMS', 'XLA_FLAGS')}\n"
+        "assert after == before, (before, after)\n"
+        "print('ENV-CLEAN')",
+        {})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ENV-CLEAN" in r.stdout
