@@ -119,10 +119,11 @@ class Engine:
                         f"smaller than the rule radius {r}: halo exchange "
                         "needs depth <= tile size; use fewer devices"
                     )
-                self._run = sharded.make_multi_step_ltl(mesh, self.rule, topology)
+                self._run = sharded.make_multi_step_ltl(mesh, self.rule, topology,
+                                                        donate=True)
             elif self._generations:
                 self._run = sharded.make_multi_step_generations(
-                    mesh, self.rule, topology
+                    mesh, self.rule, topology, donate=True
                 )
             elif backend == "sparse":
                 if sparse_opts:
@@ -135,7 +136,8 @@ class Engine:
                     )
                 # per-device activity skipping: flags ride along with state
                 self._flags = sharded.initial_flags(mesh)
-                run2 = sharded.make_multi_step_packed_sparse(mesh, self.rule, topology)
+                run2 = sharded.make_multi_step_packed_sparse(mesh, self.rule, topology,
+                                                             donate=True)
 
                 def _run(s, n):
                     s, self._flags = run2(s, self._flags, n)
@@ -148,7 +150,7 @@ class Engine:
                     if backend == "packed"
                     else sharded.make_multi_step_dense
                 )
-                self._run = make(mesh, self.rule, topology)
+                self._run = make(mesh, self.rule, topology, donate=True)
         elif backend == "sparse":
             from .ops.sparse import (
                 DEFAULT_TILE_ROWS,
@@ -174,37 +176,38 @@ class Engine:
             interpret = pallas_stencil.default_interpret()
             if not pallas_stencil.supported(state.shape, on_tpu=not interpret):
                 warnings.warn(
-                    f"pallas backend needs width % 4096 == 0 on TPU (got "
-                    f"{self.shape[1]}); falling back to the XLA packed path",
+                    f"pallas backend needs width % 4096 == 0 and height % 8 "
+                    f"== 0 on TPU (got {self.shape[0]}x{self.shape[1]}); "
+                    "falling back to the XLA packed path",
                     stacklevel=3,
                 )
                 self._run = lambda s, n: multi_step_packed(
-                    s, n, rule=self.rule, topology=self.topology
+                    s, n, rule=self.rule, topology=self.topology, donate=True
                 )
             else:
                 self._run = lambda s, n: multi_step_pallas(
                     s, int(n), rule=self.rule, topology=self.topology,
-                    interpret=interpret,
+                    interpret=interpret, donate=True,
                 )
         elif self._ltl:
             from .ops.ltl import multi_step_ltl
 
             self._run = lambda s, n: multi_step_ltl(
-                s, n, rule=self.rule, topology=self.topology
+                s, n, rule=self.rule, topology=self.topology, donate=True
             )
         elif self._generations:
             from .ops.generations import multi_step_generations
 
             self._run = lambda s, n: multi_step_generations(
-                s, n, rule=self.rule, topology=self.topology
+                s, n, rule=self.rule, topology=self.topology, donate=True
             )
         elif backend == "packed":
             self._run = lambda s, n: multi_step_packed(
-                s, n, rule=self.rule, topology=self.topology
+                s, n, rule=self.rule, topology=self.topology, donate=True
             )
         else:
             self._run = lambda s, n: multi_step(
-                s, n, rule=self.rule, topology=self.topology
+                s, n, rule=self.rule, topology=self.topology, donate=True
             )
         self._state = state
 
@@ -237,7 +240,12 @@ class Engine:
 
     @property
     def state(self) -> jax.Array:
-        """The raw device array (packed words or uint8 cells)."""
+        """The raw device array (packed words or uint8 cells).
+
+        The engine donates this buffer to the next :meth:`step` (in-place
+        double-buffering in HBM), so a reference held across a step() is
+        dead on TPU. Use :meth:`snapshot` for a stable host copy.
+        """
         if self._sparse is not None:
             return self._sparse.packed
         return self._state

@@ -1,0 +1,45 @@
+"""Opt-in buffer donation for functional op entry points.
+
+Round-1 hardware runs exposed a footgun: the ``step_*``/``multi_step_*``
+functions were jitted with their state argument *always* donated. Donation
+is a no-op on the CPU backend (so the test suite never noticed), but on TPU
+the caller's array is really consumed — any caller that touched its input
+again (compare-against-oracle harnesses, autotune sweeps re-seeding from
+one array) died with ``INVALID_ARGUMENT: TPU backend error`` at the next
+fetch. Functional APIs must not destroy their arguments by default.
+
+This module keeps donation available — the Engine owns its state buffer
+and wants in-place double-buffering (at 65536² packed that is the
+difference between 512 MB and 1 GB of HBM) — but as an explicit
+``donate=True`` opt-in. Two jitted instances are built per function
+(jax.jit donation is a trace-time property); the wrapper picks one.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence, Tuple
+
+import jax
+
+
+def optionally_donated(
+    donate_arg: str, static: Tuple[str, ...] = ("rule", "topology")
+) -> Callable:
+    """Decorator: jit ``fun`` with ``donate=False`` (default, safe) or
+    ``donate=True`` (caller hands over ``donate_arg``'s buffer)."""
+
+    def deco(fun: Callable) -> Callable:
+        plain = jax.jit(fun, static_argnames=static)
+        donating = jax.jit(fun, static_argnames=static, donate_argnames=(donate_arg,))
+
+        @functools.wraps(fun)
+        def wrapper(*args, donate: bool = False, **kwargs):
+            return (donating if donate else plain)(*args, **kwargs)
+
+        # the jit objects themselves, for .lower()/.trace() introspection
+        wrapper.jitted = plain
+        wrapper.jitted_donating = donating
+        return wrapper
+
+    return deco

@@ -11,12 +11,11 @@ two-phase ppermute halo exchange.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 from ..models.generations import GenRule
+from ._jit import optionally_donated
 from .stencil import Topology, _pad_mode, neighbor_counts_ext
 
 
@@ -37,7 +36,7 @@ def step_generations_ext(ext: jax.Array, rule: GenRule) -> jax.Array:
     ).astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+@optionally_donated("state")
 def step_generations(
     state: jax.Array, *, rule: GenRule, topology: Topology = Topology.TORUS
 ) -> jax.Array:
@@ -45,7 +44,7 @@ def step_generations(
     return step_generations_ext(jnp.pad(state, 1, **_pad_mode(topology)), rule)
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+@optionally_donated("state")
 def multi_step_generations(
     state: jax.Array,
     n: jax.Array,

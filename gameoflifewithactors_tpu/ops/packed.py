@@ -22,13 +22,13 @@ Two entry points:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..models.rules import Rule
+from ._jit import optionally_donated
 from .stencil import Topology
 
 _TOP_BIT = 31  # bit index holding the highest column of a word
@@ -105,14 +105,20 @@ def _row_triplet(p: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array
 
 def horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(west, center, east) planes of a row-aligned slab, with cross-word
-    carries; word columns wrap for TORUS and see zeros for DEAD."""
-    if topology is Topology.TORUS:
-        left = jnp.roll(slab, 1, axis=1)
-        right = jnp.roll(slab, -1, axis=1)
-    else:
-        zero_col = jnp.zeros_like(slab[:, :1])
-        left = jnp.concatenate([zero_col, slab[:, :-1]], axis=1)
-        right = jnp.concatenate([slab[:, 1:], zero_col], axis=1)
+    carries; word columns wrap for TORUS and see zeros for DEAD.
+
+    DEAD is a roll + edge-column mask rather than a concatenate of
+    unaligned slices: a lane-dimension concat has no Mosaic lowering
+    ("result/input offset mismatch on non-concat dimension"), while roll
+    (tpu.rotate) + iota select compiles in the Pallas kernel and fuses
+    just as well under plain XLA.
+    """
+    left = jnp.roll(slab, 1, axis=1)
+    right = jnp.roll(slab, -1, axis=1)
+    if topology is not Topology.TORUS:
+        cols = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 1)
+        left = jnp.where(cols == 0, jnp.uint32(0), left)
+        right = jnp.where(cols == slab.shape[1] - 1, jnp.uint32(0), right)
     return _shift_west(slab, left), slab, _shift_east(slab, right)
 
 
@@ -125,14 +131,14 @@ def neighbor_planes(p: jax.Array, topology: Topology) -> List[jax.Array]:
     return planes
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("p",))
+@optionally_donated("p")
 def step_packed(p: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS) -> jax.Array:
     """One generation on a (H, W/32) uint32 packed grid."""
     bits = bit_sliced_sum(neighbor_planes(p, topology))
     return apply_rule_planes(p, bits, rule)
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("p",))
+@optionally_donated("p")
 def multi_step_packed(
     p: jax.Array,
     n: jax.Array,

@@ -84,9 +84,12 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int, g: in
     def kernel(p_hbm, out_ref, slab_ref, sems):
         i = pl.program_id(0)
         base = i * bh
-        # 3 contiguous segments (wrap segments are contiguous since g <= bh):
-        top = jnp.where(i == 0, H - g, base - g)
-        bot = jnp.where(i == n_blocks - 1, 0, base + bh)
+        # 3 contiguous segments (wrap segments are contiguous since g <= bh).
+        # Mosaic must prove the dynamic row offsets divisible by the (8, 128)
+        # sublane tiling; the jnp.where obscures that, so assert it with
+        # multiple_of (sound: H, bh, g are all multiples of 8 natively).
+        top = pl.multiple_of(jnp.where(i == 0, H - g, base - g), 8)
+        bot = pl.multiple_of(jnp.where(i == n_blocks - 1, 0, base + bh), 8)
         d_top = pltpu.make_async_copy(
             p_hbm.at[pl.ds(top, g)], slab_ref.at[pl.ds(0, g)], sems.at[0])
         d_mid = pltpu.make_async_copy(
@@ -113,10 +116,12 @@ def supported(shape, *, on_tpu: bool) -> bool:
     """Whether the kernel can run this packed (H, Wp) shape natively.
 
     The TPU lane (last) dimension must be a multiple of 128 words (= 4096
-    cells of width); interpret mode (CPU) has no constraint.
+    cells of width) and the height a multiple of 8 (sublane tiling, so a
+    block decomposition with 8-aligned DMA offsets exists); interpret mode
+    (CPU) has no constraint.
     """
-    _, Wp = shape
-    return not on_tpu or Wp % 128 == 0
+    H, Wp = shape
+    return not on_tpu or (Wp % 128 == 0 and H % 8 == 0)
 
 
 def default_interpret() -> bool:
@@ -124,15 +129,24 @@ def default_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _pick_bh(H: int) -> int:
+def _pick_bh(H: int, native: bool = False) -> int:
+    """Largest block height <= DEFAULT_BLOCK_ROWS dividing H (8-aligned
+    when targeting real Mosaic, see the multiple_of hints in the kernel)."""
     bh = min(DEFAULT_BLOCK_ROWS, H)
-    while H % bh:
-        bh -= 1
+    step = 1
+    if native:
+        bh -= bh % 8
+        step = 8
+    while bh > 0 and H % bh:
+        bh -= step
+    if bh <= 0:
+        raise ValueError(f"no usable block height for grid height {H}")
     return bh
 
 
 @lru_cache(maxsize=64)
-def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int, interpret: bool):
+def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int,
+                  interpret: bool, donate: bool):
     """Compile-once cache: (kernel pallas_call, jitted chunk loop).
 
     Keyed on everything that shapes the lowered kernel, so Engine.step /
@@ -154,7 +168,7 @@ def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int, interp
     )
     loop = jax.jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
-        donate_argnums=0,
+        donate_argnums=(0,) if donate else (),
     )
     return loop
 
@@ -167,19 +181,27 @@ def make_pallas_step(
     block_rows: Optional[int] = None,
     gens_per_call: Optional[int] = None,
     interpret: bool = False,
+    donate: bool = False,
 ):
     """The cached (loop, g) pair advancing g generations per kernel call.
 
     ``gens_per_call`` is the temporal-blocking depth g: bigger g = less HBM
     traffic per generation but more redundant edge recompute (2g extra rows
     per block per call). g is clamped to bh so wrap DMAs stay contiguous.
+    ``donate=True`` hands the caller's buffer to the loop (owners only).
     """
     H, Wp = shape
-    bh = block_rows or _pick_bh(H)
+    bh = block_rows or _pick_bh(H, native=not interpret)
     g = min(gens_per_call or DEFAULT_GENS_PER_CALL, bh)
     if H % bh:
         raise ValueError(f"grid height {H} not divisible by block rows {bh}")
-    return _build_runner(rule, topology, (H, Wp), bh, g, interpret), g
+    if not interpret and (bh % 8 or g % 8):
+        # the multiple_of(…, 8) DMA-offset hints in the kernel are only
+        # sound when every slab boundary lands on a sublane-tile boundary
+        raise ValueError(
+            f"native TPU kernel needs block_rows ({bh}) and gens_per_call "
+            f"({g}) to be multiples of 8 (sublane tiling)")
+    return _build_runner(rule, topology, (H, Wp), bh, g, interpret, donate), g
 
 
 def multi_step_pallas(
@@ -191,16 +213,20 @@ def multi_step_pallas(
     block_rows: Optional[int] = None,
     gens_per_call: Optional[int] = None,
     interpret: bool = False,
+    donate: bool = False,
 ) -> jax.Array:
     """Advance ``n`` generations via the temporal-blocked kernel, with the
     n % g remainder handled by the XLA SWAR path. ``n`` is a Python int."""
     loop, g = make_pallas_step(
         rule, topology, p.shape,
         block_rows=block_rows, gens_per_call=gens_per_call, interpret=interpret,
+        donate=donate,
     )
     chunks, rem = divmod(int(n), g)
     if chunks:
         p = loop(p, chunks)
     if rem:
-        p = multi_step_packed(p, rem, rule=rule, topology=topology)
+        # after the loop ran, p is an internal intermediate we own
+        p = multi_step_packed(p, rem, rule=rule, topology=topology,
+                              donate=donate or chunks > 0)
     return p

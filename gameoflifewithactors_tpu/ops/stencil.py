@@ -21,12 +21,12 @@ Both must agree bit-for-bit — tests enforce it.
 from __future__ import annotations
 
 import enum
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from ..models.rules import Rule
+from ._jit import optionally_donated
 
 
 class Topology(enum.Enum):
@@ -77,13 +77,13 @@ def apply_rule(state: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
     return ((mask >> counts.astype(jnp.uint16)) & 1).astype(state.dtype)
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+@optionally_donated("state")
 def step(state: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS) -> jax.Array:
     """One generation on an unpacked (H, W) uint8 grid."""
     return apply_rule(state, neighbor_counts(state, topology), rule)
 
 
-@partial(jax.jit, static_argnames=("rule", "topology"), donate_argnames=("state",))
+@optionally_donated("state")
 def multi_step(
     state: jax.Array,
     n: jax.Array,
@@ -94,8 +94,9 @@ def multi_step(
     """Run ``n`` generations inside a single jitted loop (no host round-trips).
 
     ``n`` is a traced scalar so changing the generation count does not
-    recompile; the loop body is the fused single-step kernel with the state
-    buffer donated (in-place double-buffering under XLA).
+    recompile; the loop body is the fused single-step kernel. Pass
+    ``donate=True`` (e.g. from an owner like Engine) for in-place
+    double-buffering of the state buffer under XLA.
     """
     body = lambda _, s: apply_rule(s, neighbor_counts(s, topology), rule)
     return jax.lax.fori_loop(0, n, body, state)

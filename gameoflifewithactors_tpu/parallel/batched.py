@@ -50,7 +50,8 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def make_multi_step_packed_batched(
-    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS
+    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+    donate: bool = False,
 ) -> Callable:
     """Jitted (grids, n) -> grids over a (B, H, W/32) packed batch."""
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
@@ -63,4 +64,6 @@ def make_multi_step_packed_batched(
         gen = jax.vmap(universe_gen)
         return jax.lax.fori_loop(0, n, lambda _, t: gen(t), tiles)
 
-    return jax.jit(_run, donate_argnums=0)
+    # donation opt-in: see ops/_jit.py for why consuming the caller's batch
+    # by default is a TPU-only footgun
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())

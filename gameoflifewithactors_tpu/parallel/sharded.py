@@ -48,6 +48,7 @@ def _make_runner(
     ext_step: Callable[[jax.Array, "Rule"], jax.Array],
     multi: bool,
     depth: int = 1,
+    donate: bool = False,
 ) -> Callable:
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
@@ -63,21 +64,28 @@ def _make_runner(
         def _run(tile):
             return generation(tile)
 
-    return jax.jit(_run, donate_argnums=0)
+    # donation is opt-in (see ops/_jit.py): only buffer owners like Engine
+    # should let a runner consume the incoming grid
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
 
 
-def make_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:
+def make_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+                     donate: bool = False) -> Callable:
     """Jitted one-generation step on a 2D-sharded packed grid."""
-    return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext, multi=False)
+    return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext,
+                        multi=False, donate=donate)
 
 
-def make_multi_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:
+def make_multi_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+                           donate: bool = False) -> Callable:
     """Jitted (grid, n) -> grid running n sharded generations on-device."""
-    return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext, multi=True)
+    return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext,
+                        multi=True, donate=donate)
 
 
 def make_multi_step_packed_sparse(
-    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS
+    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+    donate: bool = False,
 ) -> Callable:
     """Sharded stepping with per-tile activity skipping.
 
@@ -119,7 +127,7 @@ def make_multi_step_packed_sparse(
     def _run(tile, flag, n):
         return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (tile, flag))
 
-    return jax.jit(_run, donate_argnums=(0, 1))
+    return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
 
 
 def initial_flags(mesh: Mesh) -> jax.Array:
@@ -132,15 +140,18 @@ def initial_flags(mesh: Mesh) -> jax.Array:
     )
 
 
-def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.TORUS) -> Callable:
+def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
+                                donate: bool = False) -> Callable:
     """Jitted (grid, n) -> grid for multi-state Generations rules: the same
     halo machinery, a different per-tile step (ops/generations.py)."""
     from ..ops.generations import step_generations_ext
 
-    return _make_runner(mesh, rule, topology, step_generations_ext, multi=True)
+    return _make_runner(mesh, rule, topology, step_generations_ext, multi=True,
+                        donate=donate)
 
 
-def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS) -> Callable:
+def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
+                        donate: bool = False) -> Callable:
     """Jitted (grid, n) -> grid for radius-r Larger-than-Life rules: the
     halo exchange ships depth-r strips (halo.py's two-phase trip keeps the
     r×r corner blocks correct with 4 sends), the per-tile step is the MXU
@@ -148,14 +159,19 @@ def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS) -
     from ..ops.ltl import step_ltl_ext
 
     return _make_runner(
-        mesh, rule, topology, step_ltl_ext, multi=True, depth=rule.radius
+        mesh, rule, topology, step_ltl_ext, multi=True, depth=rule.radius,
+        donate=donate,
     )
 
 
-def make_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:
+def make_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+                    donate: bool = False) -> Callable:
     """Jitted sharded step on an unpacked (H, W) uint8 grid (debug path)."""
-    return _make_runner(mesh, rule, topology, _dense_ext_step, multi=False)
+    return _make_runner(mesh, rule, topology, _dense_ext_step, multi=False,
+                        donate=donate)
 
 
-def make_multi_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS) -> Callable:
-    return _make_runner(mesh, rule, topology, _dense_ext_step, multi=True)
+def make_multi_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+                          donate: bool = False) -> Callable:
+    return _make_runner(mesh, rule, topology, _dense_ext_step, multi=True,
+                        donate=donate)

@@ -1,0 +1,73 @@
+"""Donation is opt-in: functional entry points must not consume arguments.
+
+Round-1 TPU runs surfaced that always-donating jits (ops/_jit.py has the
+story) killed any caller that reused its input — invisible on the CPU
+backend, fatal on TPU. These tests pin the contract: by default the input
+array survives and can be re-passed (want/got harness pattern); with
+``donate=True`` the call still computes the same result (the donated
+variant is a distinct jit instance, so both code paths need exercising).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models.generations import parse_any
+from gameoflifewithactors_tpu.models.ltl import parse_ltl
+from gameoflifewithactors_tpu.models.rules import CONWAY
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.generations import multi_step_generations
+from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed, step_packed
+from gameoflifewithactors_tpu.ops.pallas_stencil import multi_step_pallas
+from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+
+def _soup(shape, hi=2, dtype=np.uint8, seed=11):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, hi, size=shape, dtype=dtype))
+
+
+CASES = [
+    ("dense", lambda p, n, **kw: multi_step(p, n, rule=CONWAY, **kw),
+     lambda: _soup((48, 48))),
+    ("packed", lambda p, n, **kw: multi_step_packed(p, n, rule=CONWAY, **kw),
+     lambda: _soup((48, 2), hi=2 ** 32, dtype=np.uint32)),
+    # gens_per_call=4 < n so the pallas *loop* runs (chunks=1) and the
+    # remainder path too — both donation flags are exercised
+    ("pallas", lambda p, n, **kw: multi_step_pallas(
+        p, n, rule=CONWAY, interpret=True, gens_per_call=4, **kw),
+     lambda: _soup((48, 2), hi=2 ** 32, dtype=np.uint32)),
+    ("generations", lambda p, n, **kw: multi_step_generations(
+        p, n, rule=parse_any("brain"), **kw),
+     lambda: _soup((48, 48), hi=3)),
+    ("ltl", lambda p, n, **kw: multi_step_ltl(p, n, rule=parse_ltl("bosco"), **kw),
+     lambda: _soup((48, 48))),
+]
+
+
+@pytest.mark.parametrize("name,run,mk", CASES, ids=[c[0] for c in CASES])
+def test_input_survives_by_default(name, run, mk):
+    p = mk()
+    first = run(p, 5)
+    # the caller's array must still be usable: re-run from the same input
+    assert not p.is_deleted()
+    again = run(p, 5)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(again))
+
+
+@pytest.mark.parametrize("name,run,mk", CASES, ids=[c[0] for c in CASES])
+def test_donating_variant_matches(name, run, mk):
+    p = mk()
+    want = np.asarray(run(p, 5))
+    got = np.asarray(run(mk(), 5, donate=True))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_step_packed_donation_contract():
+    p = _soup((32, 2), hi=2 ** 32, dtype=np.uint32)
+    a = step_packed(p, rule=CONWAY, topology=Topology.DEAD)
+    assert not p.is_deleted()
+    b = step_packed(p, rule=CONWAY, topology=Topology.DEAD)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    bitpack.unpack(a)  # outputs stay live either way

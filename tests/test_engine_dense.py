@@ -80,6 +80,6 @@ def test_multi_step_dynamic_n_no_recompile():
     g = jax.numpy.zeros((16, 16), dtype=jax.numpy.uint8)
     # n must stay a traced scalar operand (not a static arg), so different
     # generation counts share one executable.
-    avals = multi_step.lower(g, 3, rule=CONWAY).in_avals
+    avals = multi_step.jitted.lower(g, 3, rule=CONWAY).in_avals
     assert any(a.shape == () and "int" in a.dtype.name for a in jax.tree.leaves(avals))
     multi_step(g, 5, rule=CONWAY)  # different n: must not need a new lowering

@@ -1,4 +1,4 @@
-"""Larger-than-Life: parser, conv stepper vs oracle, deep halos, engine."""
+"""Larger-than-Life: parser, log-tree stepper vs oracle, deep halos, engine."""
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +8,7 @@ import pytest
 from gameoflifewithactors_tpu import Engine
 from gameoflifewithactors_tpu.models.generations import parse_any
 from gameoflifewithactors_tpu.models.ltl import BOSCO, MAJORITY, LtLRule, parse_ltl
-from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl, step_ltl
+from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl, sliding_sum, step_ltl
 from gameoflifewithactors_tpu.ops.stencil import Topology
 
 
@@ -163,3 +163,28 @@ def test_checkpoint_version_stamp_per_layout(tmp_path):
     e2 = Engine(g, "B2/S/C3")
     ckpt.save(e2, tmp_path / "multi.npz")
     assert ckpt.load_grid(tmp_path / "multi.npz")[1]["version"] == 2
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 5, 7, 11, 15, 16, 31])
+def test_sliding_sum_matches_direct(k):
+    rng = np.random.default_rng(k)
+    x = rng.integers(0, 9, size=(37, 41), dtype=np.int32)
+    for axis in (0, 1):
+        if k > x.shape[axis]:
+            continue
+        got = np.asarray(sliding_sum(jnp.asarray(x), k, axis=axis))
+        n = x.shape[axis]
+        want = sum(
+            np.take(x, range(d, d + n - k + 1), axis=axis) for d in range(k)
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_sliding_sum_full_width_and_bounds():
+    x = jnp.asarray(np.arange(12, dtype=np.int32).reshape(3, 4))
+    np.testing.assert_array_equal(
+        np.asarray(sliding_sum(x, 4, axis=1)), np.asarray(x).sum(axis=1, keepdims=True))
+    with pytest.raises(ValueError):
+        sliding_sum(x, 5, axis=1)
+    with pytest.raises(ValueError):
+        sliding_sum(x, 0, axis=1)
