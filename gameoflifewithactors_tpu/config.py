@@ -29,7 +29,7 @@ class SimulationConfig:
     random_fill: Optional[float] = None     # Bernoulli p (overrides seed)
     seed_origin: Optional[Tuple[int, int]] = None
     rng_seed: int = 0
-    backend: str = "packed"                 # packed | dense | pallas | sparse
+    backend: str = "auto"                   # auto | packed | dense | pallas | sparse
     sparse_tile: Optional[Tuple[int, int]] = None   # (rows, cols), cols % 32 == 0
     sparse_capacity: Optional[int] = None   # max active tiles before dense fallback
     mesh: Optional[str] = None              # None | "auto" | "2x4"
@@ -158,9 +158,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed-at", type=_parse_geometry, default=None, metavar="RxC",
                    help="pattern top-left placement (default: centered)")
     p.add_argument("--rng-seed", type=int, default=0)
-    p.add_argument("--backend", choices=["packed", "dense", "pallas", "sparse"], default="packed")
+    p.add_argument("--backend", choices=["auto", "packed", "dense", "pallas", "sparse"],
+                   default="auto")
     p.add_argument("--sparse-tile", type=_parse_geometry, default=None, metavar="RxC",
-                   help="sparse backend tile size in cells; C % 32 == 0 (default 32x128)")
+                   help="sparse backend tile size in cells; C % 32 == 0 "
+                        "(default: auto-scaled so the activity map stays small; "
+                        "32x128 for small grids)")
     p.add_argument("--sparse-capacity", type=int, default=None, metavar="N",
                    help="sparse backend: max active tiles per step before dense fallback")
     p.add_argument("--mesh", default=None,

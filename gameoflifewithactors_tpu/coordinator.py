@@ -64,7 +64,7 @@ class GridCoordinator:
         rng_seed: int = 0,
         topology: Topology = Topology.TORUS,
         mesh: Optional[Mesh] = None,
-        backend: str = "packed",
+        backend: str = "auto",
         sparse_opts: Optional[dict] = None,
         track_population: bool = False,
         metrics: Optional[MetricsLogger] = None,

@@ -45,7 +45,9 @@ class Engine:
     rule: a Rule or rule string ("B3/S23", "highlife", ...).
     topology: TORUS (wrap) or DEAD (all-dead boundary).
     mesh: optional jax Mesh for 2D sharding; None = single device.
-    backend: "packed" (32 cells/word SWAR, the default fast path), "dense"
+    backend: "auto" (default: picks "pallas" on a single TPU device for
+        3x3 binary rules at supported shapes, else "packed"), "packed"
+        (32 cells/word SWAR fast path), "dense"
         (1 byte/cell, debug path), "pallas" (temporal-blocked Mosaic
         kernel advancing several generations per HBM round-trip;
         single-device only — the sharded engines use the packed path), or
@@ -62,14 +64,17 @@ class Engine:
         *,
         topology: Topology = Topology.TORUS,
         mesh: Optional[Mesh] = None,
-        backend: str = "packed",
+        backend: str = "auto",
         sparse_opts: Optional[dict] = None,
     ):
-        if backend not in BACKENDS:
-            raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
+        if backend not in BACKENDS and backend != "auto":
+            raise ValueError(
+                f"backend must be 'auto' or one of {BACKENDS}, got {backend!r}")
         self.rule = parse_any(rule)
         self._generations = isinstance(self.rule, GenRule)
         self._ltl = isinstance(self.rule, LtLRule)
+        if backend == "auto":
+            backend = self._resolve_auto(grid, mesh)
         if (self._generations or self._ltl) and backend in ("pallas", "sparse"):
             raise ValueError(
                 f"backend={backend!r} is 3x3-binary-only; "
@@ -159,9 +164,12 @@ class Engine:
             )
 
             opts = dict(sparse_opts or {})
+            # pre-validate in cell units only for explicit tile opts;
+            # without them SparseEngineState auto-tiles divisibly
             tr = opts.get("tile_rows", DEFAULT_TILE_ROWS)
             tw = opts.get("tile_words", DEFAULT_TILE_WORDS)
-            if self.shape[0] % tr or self.shape[1] % (bitpack.WORD * tw):
+            if (("tile_rows" in opts or "tile_words" in opts)
+                    and (self.shape[0] % tr or self.shape[1] % (bitpack.WORD * tw))):
                 raise ValueError(
                     f"grid {self.shape} not divisible into sparse tiles of "
                     f"{tr} x {bitpack.WORD * tw} cells; pass sparse_opts="
@@ -210,6 +218,23 @@ class Engine:
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
         self._state = state
+
+    def _resolve_auto(self, grid, mesh: Optional[Mesh]) -> str:
+        """'auto' = the fastest correct backend for this rule/platform/shape:
+        the temporal-blocked native Pallas kernel (measured ~2.8x the XLA
+        SWAR rate on a v5e) for single-device 3x3 binary rules at shapes it
+        supports; the packed SWAR path everywhere else (multi-state / LtL
+        rules route to their dense steppers off 'packed')."""
+        if mesh is not None or self._generations or self._ltl:
+            return "packed"
+        shape = np.shape(grid)
+        if len(shape) != 2 or shape[1] % bitpack.WORD:
+            return "packed"  # shape errors surface in the main path
+        on_tpu = not pallas_stencil.default_interpret()
+        if on_tpu and pallas_stencil.supported(
+                (shape[0], shape[1] // bitpack.WORD), on_tpu=True):
+            return "pallas"
+        return "packed"
 
     # -- stepping ------------------------------------------------------------
 

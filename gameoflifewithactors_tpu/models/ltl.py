@@ -1,10 +1,10 @@
 """Larger-than-Life rule family — radius-r Moore neighborhoods.
 
 Life-like rules look at 8 neighbors; Larger-than-Life (Evans) counts live
-cells in a (2r+1)² box and births/survives on *intervals*. This is the
-family where the TPU's MXU earns its keep: the box count is a separable
-pair of 1-D convolutions in bf16 (exact for counts < 256, i.e. r <= 7)
-instead of the VPU bitwise path the 3×3 rules use.
+cells in a (2r+1)² box and births/survives on *intervals*. The box count
+is a separable pair of log-tree sliding-window sums in int32 on the VPU
+(ops/ltl.py — a conv-based MXU design was measured ~50x slower on chip
+and replaced), alongside the bitwise SWAR path the 3×3 rules use.
 
 Notation (Golly's LtL form): ``R5,C0,M1,S34..58,B34..45`` —
 radius R, states C (only C0/C2 = binary supported here), M1 counts the
@@ -19,7 +19,8 @@ import dataclasses
 import re
 from typing import Tuple
 
-MAX_RADIUS = 7  # (2r+1)^2 - 1 < 256 keeps bf16 MXU accumulation exact
+MAX_RADIUS = 7  # policy cap (int32 tree is exact at any radius): keeps
+# halo-exchange depth and window shapes modest on sharded meshes
 
 
 @dataclasses.dataclass(frozen=True)

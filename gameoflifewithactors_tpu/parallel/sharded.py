@@ -154,8 +154,8 @@ def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
                         donate: bool = False) -> Callable:
     """Jitted (grid, n) -> grid for radius-r Larger-than-Life rules: the
     halo exchange ships depth-r strips (halo.py's two-phase trip keeps the
-    r×r corner blocks correct with 4 sends), the per-tile step is the MXU
-    conv path (ops/ltl.py). Tiles must be at least r cells in each dim."""
+    r×r corner blocks correct with 4 sends), the per-tile step is the
+    log-tree window-sum path (ops/ltl.py). Tiles must be at least r cells in each dim."""
     from ..ops.ltl import step_ltl_ext
 
     return _make_runner(

@@ -16,7 +16,7 @@ Items:
   bench_packed      north-star: bench.py packed @16384² (persists best)
   pallas_identity   native-Mosaic kernel bit-identity vs XLA SWAR on-chip
   pallas_autotune   sweep (block_rows, gens_per_call), record best rate
-  ltl_bosco         LtL bf16-conv path: on-chip bit-identity vs CPU + rate
+  ltl_bosco         LtL log-tree path: on-chip bit-identity vs CPU + rate
   generations_brain Generations path: on-chip bit-identity vs CPU + rate
   ltl_lowering      compiled-HLO evidence the LtL step lowers conv-free (VPU tree)
   config5_sparse    65536² Gosper gun sparse on the chip
@@ -117,11 +117,12 @@ def child_pallas_autotune() -> dict:
     p = jnp.asarray(rng.integers(0, 2 ** 32, size=(side, side // 32), dtype=np.uint32))
     results, best = [], None
     # bh and g must be multiples of 8 natively (sublane-aligned DMA offsets).
-    # g > 32 is excluded: the in-kernel generation loop is unrolled g times,
-    # and Mosaic compile time on those kernels blows the item watchdog while
-    # the redundant-compute fraction (2g/bh) makes them losers anyway.
+    # g > 16 is excluded: the in-kernel generation loop is unrolled g times
+    # and Mosaic compile time on those kernels (minutes each) blew two item
+    # watchdogs, while the HBM-traffic win beyond g=16 is marginal — the
+    # kernel is already compute-bound there (see results/tpu_worklist.json).
     for bh in (256, 512, 1024):
-        for g in (8, 16, 32):
+        for g in (8, 16):
             if g > bh:
                 continue
             try:
@@ -182,7 +183,9 @@ def _rule_child(rule_name: str, side: int) -> dict:
     big = jnp.asarray(rng.integers(0, n_states, size=(side, side), dtype=np.uint8))
     s = run(big, 4, rule=rule, topology=Topology.TORUS)
     _sync_scalar(s)
-    gens = 32
+    # >= 512 gens per rep: at ~65 ms/dispatch tunnel latency, short runs
+    # measure the tunnel, not the chip
+    gens = 512
     best = 0.0
     for _ in range(2):
         t0 = time.perf_counter()

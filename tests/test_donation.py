@@ -64,6 +64,18 @@ def test_donating_variant_matches(name, run, mk):
     np.testing.assert_array_equal(want, got)
 
 
+def test_default_variant_lowered_without_aliasing():
+    # The real pin for the round-1 TPU bug: donation is a no-op on the CPU
+    # backend these tests run on, so is_deleted()/reuse checks above cannot
+    # fail if someone reverts to always-donating jits. The lowered MLIR can:
+    # donated args carry tf.aliasing_output, on every backend.
+    p = _soup((32, 2), hi=2 ** 32, dtype=np.uint32)
+    plain = multi_step_packed.jitted.lower(p, 3, rule=CONWAY).as_text()
+    donating = multi_step_packed.jitted_donating.lower(p, 3, rule=CONWAY).as_text()
+    assert "tf.aliasing_output" not in plain
+    assert "tf.aliasing_output" in donating
+
+
 def test_step_packed_donation_contract():
     p = _soup((32, 2), hi=2 ** 32, dtype=np.uint32)
     a = step_packed(p, rule=CONWAY, topology=Topology.DEAD)

@@ -193,3 +193,16 @@ def test_engine_pallas_backend():
     with pytest.raises(ValueError, match="single-device"):
         Engine(np.zeros((16, 256), np.uint8), "conway", backend="pallas",
                mesh=mesh_lib.make_mesh((2, 4)))
+
+
+def test_auto_backend_resolution_off_tpu():
+    # tests force the CPU backend, so auto must resolve to packed — the
+    # pallas pick only happens on a real TPU (covered by the TPU worklist)
+    import numpy as np
+
+    e = Engine(np.zeros((16, 32), np.uint8), "B3/S23")
+    assert e.backend == "packed"
+    e2 = Engine(np.zeros((16, 32), np.uint8), "brain")  # multi-state
+    assert e2.backend == "packed"
+    with pytest.raises(ValueError, match="backend must be"):
+        Engine(np.zeros((16, 32), np.uint8), "B3/S23", backend="warp")

@@ -100,9 +100,15 @@ def test_engine_sparse_opts_and_cell_unit_errors():
     e.step(4)
     assert e.population() == 5
     assert e._state is None  # no dead second copy of the grid
+    # no explicit opts: auto_tile adapts to the narrow grid (width 64 =
+    # 2 packed words -> 2-word tiles) instead of failing on the defaults
+    e2 = Engine(np.zeros((64, 64), np.uint8), "conway", backend="sparse",
+                topology=Topology.DEAD)
+    assert e2._sparse.tile_words == 2
+    # explicitly indivisible opts still fail with a cell-unit message
     with pytest.raises(ValueError, match=r"64, 64"):
         Engine(np.zeros((64, 64), np.uint8), "conway", backend="sparse",
-               topology=Topology.DEAD)
+               topology=Topology.DEAD, sparse_opts=dict(tile_words=4))
 
 
 # -- sharded sparse: per-device activity skipping -----------------------------
@@ -285,3 +291,31 @@ def test_sparse_torus_capacity_overflow_dense_fallback():
     g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)  # everything awake
     got, _ = _sparse_torus(g, 12, tile_rows=16, tile_words=1, capacity=4)
     np.testing.assert_array_equal(got, _torus_reference(g, 12))
+
+
+def test_auto_tile_defaults_and_scaling():
+    from gameoflifewithactors_tpu.ops.sparse import MAX_MAP_ENTRIES, auto_tile
+
+    # small grids keep the defaults
+    assert auto_tile(1024, 32) == (32, 4)
+    # 65536^2 packed is (65536, 2048): the map must shrink to <= 2^16
+    tr, tw = auto_tile(65536, 2048)
+    assert (65536 // tr) * (2048 // tw) <= MAX_MAP_ENTRIES
+    assert 65536 % tr == 0 and 2048 % tw == 0
+    # indivisible shapes degrade but never violate divisibility
+    tr, tw = auto_tile(96, 6)
+    assert 96 % tr == 0 and 6 % tw == 0
+
+
+def test_sparse_auto_tiles_match_explicit_tiles():
+    # same universe stepped with auto-chosen vs default tiles: identical
+    rng = np.random.default_rng(5)
+    g = np.zeros((256, 256), np.uint8)
+    g[100:140, 60:200] = rng.integers(0, 2, (40, 140), np.uint8)
+    p = jnp.asarray(bitpack.pack(jnp.asarray(g)))
+    a = SparseEngineState(p, CONWAY, topology=Topology.TORUS)
+    b = SparseEngineState(p, CONWAY, tile_rows=64, tile_words=8,
+                          topology=Topology.TORUS)
+    a.step(48)
+    b.step(48)
+    np.testing.assert_array_equal(np.asarray(a.packed), np.asarray(b.packed))
