@@ -152,6 +152,29 @@ def multi_step_packed(
     return jax.lax.fori_loop(0, n, body, p)
 
 
+def step_packed_slab(slab: jax.Array, rule: Rule, topology: Topology) -> jax.Array:
+    """One generation for the interior rows of a (L, Wp) slab -> (L-2, Wp).
+
+    Rows shrink (vertical halos consumed); columns use ``topology`` across
+    the slab's own width: TORUS when the slab spans the full grid width
+    (the Pallas kernel's blocks), DEAD when cells beyond the slab are
+    unknown-and-treated-dead (the communication-avoiding sharded runner,
+    whose 32-cell halo words absorb the resulting edge corruption).
+    """
+    h = slab.shape[0] - 2
+    planes = []
+    alive = None
+    for dv in (0, 1, 2):
+        s = jax.lax.slice_in_dim(slab, dv, dv + h, axis=0)
+        w, c, e = horizontal_planes(s, topology)
+        if dv == 1:
+            alive = c
+            planes.extend([w, e])
+        else:
+            planes.extend([w, c, e])
+    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)
+
+
 def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
     """(alive, 8 neighbor planes) from a halo-extended (h+2, wp+2) tile.
 

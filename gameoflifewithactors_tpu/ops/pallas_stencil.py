@@ -38,30 +38,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.rules import Rule
 from .stencil import Topology
-from .packed import apply_rule_planes, bit_sliced_sum, horizontal_planes, multi_step_packed
+from .packed import multi_step_packed, step_packed_slab as step_rows
 
 DEFAULT_BLOCK_ROWS = 256
 DEFAULT_GENS_PER_CALL = 8
-
-
-def step_rows(slab: jax.Array, rule: Rule, topology: Topology) -> jax.Array:
-    """One generation for the interior rows of a (L, Wp) slab -> (L-2, Wp).
-
-    Rows shrink (vertical halos consumed); columns use the grid's own
-    topology since the slab spans the full width.
-    """
-    h = slab.shape[0] - 2
-    planes = []
-    alive = None
-    for dv in (0, 1, 2):
-        s = jax.lax.slice_in_dim(slab, dv, dv + h, axis=0)
-        w, c, e = horizontal_planes(s, topology)
-        if dv == 1:
-            alive = c
-            planes.extend([w, e])
-        else:
-            planes.extend([w, c, e])
-    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)
 
 
 def _zero_exterior(slab, block_idx, n_blocks, halo, topology):

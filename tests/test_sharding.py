@@ -192,3 +192,53 @@ def test_multislice_mesh_bit_identity():
     p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
     run = sharded.make_multi_step_packed(m, CONWAY, Topology.TORUS)
     np.testing.assert_array_equal(np.asarray(bitpack.unpack(run(p, 16))), want)
+
+
+class TestCommunicationAvoiding:
+    """make_multi_step_packed_deep: one exchange per g generations."""
+
+    def _mesh(self, shape=(2, 4)):
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        return mesh_lib.make_mesh(shape)
+
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    @pytest.mark.parametrize("g", [1, 3, 8, 32])
+    def test_bit_identity_vs_per_gen_exchange(self, topology, g):
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        m = self._mesh()
+        rng = np.random.default_rng(17)
+        grid = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+        p_single = bitpack.pack(jnp.asarray(grid))
+        chunks = 3
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            p_single, chunks * g, rule=CONWAY, topology=topology)))
+
+        p = mesh_lib.device_put_sharded_grid(p_single, m)
+        run = sharded.make_multi_step_packed_deep(
+            m, CONWAY, topology, gens_per_exchange=g)
+        got = np.asarray(bitpack.unpack(run(p, chunks)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_glider_crosses_tile_corner_under_deep_halo(self):
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        m = self._mesh()
+        # a glider aimed through the (row, col) tile corner at (32, 64)
+        grid = np.asarray(seeds.seeded((64, 256), "glider", 28, 60))
+        p_single = bitpack.pack(jnp.asarray(grid))
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            p_single, 24, rule=CONWAY, topology=Topology.TORUS)))
+        run = sharded.make_multi_step_packed_deep(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=8)
+        got = np.asarray(bitpack.unpack(
+            run(mesh_lib.device_put_sharded_grid(p_single, m), 3)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_rejects_out_of_range_depth(self):
+        m = self._mesh()
+        with pytest.raises(ValueError, match=r"\[1, 32\]"):
+            sharded.make_multi_step_packed_deep(m, CONWAY, gens_per_exchange=33)
+        with pytest.raises(ValueError, match=r"\[1, 32\]"):
+            sharded.make_multi_step_packed_deep(m, CONWAY, gens_per_exchange=0)
