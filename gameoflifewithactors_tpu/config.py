@@ -30,6 +30,7 @@ class SimulationConfig:
     seed_origin: Optional[Tuple[int, int]] = None
     rng_seed: int = 0
     backend: str = "auto"                   # auto | packed | dense | pallas | sparse
+    gens_per_exchange: int = 1              # sharded packed: halo depth G, exchange every G gens
     sparse_tile: Optional[Tuple[int, int]] = None   # (rows, cols), cols % 32 == 0
     sparse_capacity: Optional[int] = None   # max active tiles before dense fallback
     mesh: Optional[str] = None              # None | "auto" | "2x4"
@@ -123,6 +124,7 @@ class SimulationConfig:
                 mesh=mesh,
                 backend=self.backend,
                 sparse_opts=self.build_sparse_opts(),
+                gens_per_exchange=self.gens_per_exchange,
                 track_population=self.track_population,
                 metrics=self.build_metrics(),
                 view_shape=(self.view_height, self.view_width),
@@ -160,6 +162,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--rng-seed", type=int, default=0)
     p.add_argument("--backend", choices=["auto", "packed", "dense", "pallas", "sparse"],
                    default="auto")
+    p.add_argument("--gens-per-exchange", type=int, default=1, metavar="G",
+                   help="sharded packed backend: exchange a depth-G halo every "
+                        "G generations instead of 1-deep every generation "
+                        "(communication-avoiding; bit-exact for G <= 32)")
     p.add_argument("--sparse-tile", type=_parse_geometry, default=None, metavar="RxC",
                    help="sparse backend tile size in cells; C % 32 == 0 "
                         "(default: auto-scaled so the activity map stays small; "
@@ -199,6 +205,7 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         seed_origin=args.seed_at,
         rng_seed=args.rng_seed,
         backend=args.backend,
+        gens_per_exchange=args.gens_per_exchange,
         sparse_tile=args.sparse_tile,
         sparse_capacity=args.sparse_capacity,
         mesh=args.mesh,

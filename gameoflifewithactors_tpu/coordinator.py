@@ -66,13 +66,15 @@ class GridCoordinator:
         mesh: Optional[Mesh] = None,
         backend: str = "auto",
         sparse_opts: Optional[dict] = None,
+        gens_per_exchange: int = 1,
         track_population: bool = False,
         metrics: Optional[MetricsLogger] = None,
         view_shape: Optional[Tuple[int, int]] = None,
     ):
         grid = self._build_seed(shape, seed, seed_origin, random_fill, rng_seed)
         engine = Engine(grid, rule, topology=topology, mesh=mesh, backend=backend,
-                        sparse_opts=sparse_opts)
+                        sparse_opts=sparse_opts,
+                        gens_per_exchange=gens_per_exchange)
         self._init_from_engine(engine, track_population, metrics, view_shape)
 
     def _init_from_engine(self, engine, track_population, metrics, view_shape) -> None:

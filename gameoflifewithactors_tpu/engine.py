@@ -55,6 +55,9 @@ class Engine:
         huge mostly-empty universes; both topologies on one device —
         torus refreshes the halo ring with wrapped edges each generation
         — and with a mesh it shards with per-device activity skipping).
+    gens_per_exchange: sharded packed backend only — G > 1 exchanges a
+        depth-G halo once per G generations (communication-avoiding;
+        bit-exact for G <= 32) instead of a 1-deep halo every generation.
     """
 
     def __init__(
@@ -66,6 +69,7 @@ class Engine:
         mesh: Optional[Mesh] = None,
         backend: str = "auto",
         sparse_opts: Optional[dict] = None,
+        gens_per_exchange: int = 1,
     ):
         if backend not in BACKENDS and backend != "auto":
             raise ValueError(
@@ -75,6 +79,15 @@ class Engine:
         self._ltl = isinstance(self.rule, LtLRule)
         if backend == "auto":
             backend = self._resolve_auto(grid, mesh)
+        if gens_per_exchange < 1:
+            raise ValueError(
+                f"gens_per_exchange must be >= 1, got {gens_per_exchange}")
+        if gens_per_exchange != 1 and not (
+                mesh is not None and backend == "packed"
+                and not (self._generations or self._ltl)):
+            raise ValueError(
+                "gens_per_exchange applies to the sharded packed backend "
+                "only (mesh + backend='packed'/'auto', 3x3 binary rule)")
         if (self._generations or self._ltl) and backend in ("pallas", "sparse"):
             raise ValueError(
                 f"backend={backend!r} is 3x3-binary-only; "
@@ -84,6 +97,7 @@ class Engine:
         self.topology = topology
         self.mesh = mesh
         self.backend = backend
+        self.gens_per_exchange = gens_per_exchange
         np_grid = np.asarray(grid, dtype=np.uint8)
         self._validate_states(np_grid)
         grid = jnp.asarray(np_grid)
@@ -156,6 +170,24 @@ class Engine:
                     else sharded.make_multi_step_dense
                 )
                 self._run = make(mesh, self.rule, topology, donate=True)
+                if gens_per_exchange > 1 and backend == "packed":
+                    # communication-avoiding: bulk generations go through
+                    # the depth-g runner; n % g remainders use the per-gen
+                    # runner built above
+                    deep = sharded.make_multi_step_packed_deep(
+                        mesh, self.rule, topology,
+                        gens_per_exchange=gens_per_exchange, donate=True)
+                    pergen, g = self._run, gens_per_exchange
+
+                    def _run_deep(s, n):
+                        chunks, rem = divmod(int(n), g)
+                        if chunks:
+                            s = deep(s, chunks)
+                        if rem:
+                            s = pergen(s, rem)
+                        return s
+
+                    self._run = _run_deep
         elif backend == "sparse":
             from .ops.sparse import (
                 DEFAULT_TILE_ROWS,
@@ -286,9 +318,11 @@ class Engine:
 
     def halo_bytes_per_gen(self) -> int:
         """Estimated interconnect (ICI/DCN) bytes one generation moves: the
-        four ppermute strips per device tile (halo.py). 0 when unsharded —
-        the analogue of the reference's ~9·N·M mailbox messages/generation
-        (SURVEY.md §4b) collapsing to 4 strip sends per *tile*."""
+        four ppermute strips per device tile (halo.py), amortized over the
+        exchange period when the communication-avoiding runner is active
+        (gens_per_exchange > 1). 0 when unsharded — the analogue of the
+        reference's ~9·N·M mailbox messages/generation (SURVEY.md §4b)
+        collapsing to 4 strip sends per *tile*."""
         if self.mesh is None:
             return 0
         nx = self.mesh.shape[mesh_lib.ROW_AXIS]
@@ -297,15 +331,27 @@ class Engine:
         wq = (w // bitpack.WORD) if self._packed else w
         itemsize = 4 if self._packed else 1
         depth = self.rule.radius if self._ltl else 1  # strip depth in rows/cols
-        row_strip = depth * (wq // ny) * itemsize  # d rows of one tile
-        # d columns of a row-extended (h + 2d rows) tile
-        col_strip = depth * (h // nx + 2 * depth) * itemsize
+        g = self.gens_per_exchange
+        if g > 1:
+            # communication-avoiding runner: one exchange of g-deep row
+            # strips + 1-word column strips per g generations, amortized
+            row_strip = g * (wq // ny) * itemsize
+            col_strip = 1 * (h // nx + 2 * g) * itemsize
+        else:
+            row_strip = depth * (wq // ny) * itemsize  # d rows of one tile
+            # d columns of a row-extended (h + 2d rows) tile
+            col_strip = depth * (h // nx + 2 * depth) * itemsize
         wrap = self.topology is Topology.TORUS
         # a size-1 axis exchanges nothing over the interconnect (the torus
         # "send" is a device-local self-copy); DEAD edges drop the wrap send
         row_sends = 2 * ny * (nx if wrap else nx - 1) if nx > 1 else 0
         col_sends = 2 * nx * (ny if wrap else ny - 1) if ny > 1 else 0
         total = row_sends * row_strip + col_sends * col_strip
+        if g > 1:
+            # per-generation figure: the chunk's bytes spread over g gens
+            # (n % g remainder generations pay the 1-deep rate; ignored —
+            # this is an estimate, and bulk stepping dominates)
+            total = -(-total // g)  # ceil
         if self._flags is not None:
             # sharded sparse also halo-exchanges the (1,1) uint32 activity
             # flag: 4-byte row strips, 12-byte (3,1) column strips

@@ -242,3 +242,32 @@ class TestCommunicationAvoiding:
             sharded.make_multi_step_packed_deep(m, CONWAY, gens_per_exchange=33)
         with pytest.raises(ValueError, match=r"\[1, 32\]"):
             sharded.make_multi_step_packed_deep(m, CONWAY, gens_per_exchange=0)
+
+    def test_engine_facade_gens_per_exchange(self):
+        from gameoflifewithactors_tpu import Engine
+
+        m = self._mesh()
+        grid = np.asarray(seeds.seeded((64, 256), "glider", 10, 10))
+        want = Engine(grid, "conway", mesh=m)
+        got = Engine(grid, "conway", mesh=m, gens_per_exchange=8)
+        # 19 = 2 deep chunks + 3 per-gen remainder
+        want.step(19)
+        got.step(19)
+        np.testing.assert_array_equal(want.snapshot(), got.snapshot())
+        with pytest.raises(ValueError, match="sharded packed backend"):
+            Engine(grid, "conway", gens_per_exchange=8)  # no mesh
+        with pytest.raises(ValueError, match="sharded packed backend"):
+            Engine(grid, "brain", mesh=m, gens_per_exchange=8)  # multi-state
+
+    def test_deep_mode_halo_estimate_and_validation(self):
+        from gameoflifewithactors_tpu import Engine
+
+        m = self._mesh()
+        grid = np.zeros((64, 256), np.uint8)
+        base = Engine(grid, "conway", mesh=m).halo_bytes_per_gen()
+        deep = Engine(grid, "conway", mesh=m,
+                      gens_per_exchange=8).halo_bytes_per_gen()
+        # one depth-8 exchange per 8 gens amortizes well below per-gen strips
+        assert 0 < deep < base
+        with pytest.raises(ValueError, match=">= 1"):
+            Engine(grid, "conway", mesh=m, gens_per_exchange=0)
