@@ -92,7 +92,9 @@ class Engine:
             raise ValueError(
                 f"backend={backend!r} is 3x3-binary-only; "
                 f"{type(self.rule).__name__} rules ({self.rule.notation}) run "
-                "on the dense path (backend='packed' or 'dense' both route there)"
+                "on their own steppers (backend='packed' is the bit-plane "
+                "stack for Generations, dense for LtL; backend='dense' is "
+                "the byte layout)"
             )
         self.topology = topology
         self.mesh = mesh
@@ -108,6 +110,12 @@ class Engine:
 
         self._packed = (backend in ("packed", "pallas", "sparse")
                         and not (self._generations or self._ltl))
+        # Generations on one device with the packed backend: bit-plane
+        # stack (ops/packed_generations.py), ~4x less HBM traffic than the
+        # byte layout; sharded Generations keeps the dense layout
+        self._gen_packed = (self._generations and mesh is None
+                            and backend == "packed"
+                            and self.shape[1] % bitpack.WORD == 0)
         self._sparse = None
         self._flags = None
         if mesh is not None:
@@ -127,7 +135,12 @@ class Engine:
                     f"need height % {nx} == 0 and width % {wq} == 0"
                     + (" (bit-packed backends shard 32-cell words)" if self._packed else "")
                 )
-        state = bitpack.pack(grid) if self._packed else grid
+        if self._gen_packed:
+            from .ops.packed_generations import pack_generations_for
+
+            state = pack_generations_for(grid, self.rule)
+        else:
+            state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
             if self._ltl:
@@ -235,6 +248,12 @@ class Engine:
             self._run = lambda s, n: multi_step_ltl(
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
+        elif self._gen_packed:
+            from .ops.packed_generations import multi_step_packed_generations
+
+            self._run = lambda s, n: multi_step_packed_generations(
+                s, n, rule=self.rule, topology=self.topology, donate=True
+            )
         elif self._generations:
             from .ops.generations import multi_step_generations
 
@@ -255,8 +274,9 @@ class Engine:
         """'auto' = the fastest correct backend for this rule/platform/shape:
         the temporal-blocked native Pallas kernel (measured ~2.8x the XLA
         SWAR rate on a v5e) for single-device 3x3 binary rules at shapes it
-        supports; the packed SWAR path everywhere else (multi-state / LtL
-        rules route to their dense steppers off 'packed')."""
+        supports; the packed SWAR path everywhere else. Off 'packed',
+        Generations rules take the bit-plane stack when the width packs
+        (% 32), the byte path otherwise; LtL rules are always dense."""
         if mesh is not None or self._generations or self._ltl:
             return "packed"
         shape = np.shape(grid)
@@ -311,7 +331,12 @@ class Engine:
         """The full grid as host uint8 (H, W); optionally block-max downsampled
         *on device* to fit within ``max_shape`` before transfer, so rendering
         a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
-        dense = bitpack.unpack(self.state) if self._packed else self.state
+        if self._gen_packed:
+            from .ops.packed_generations import unpack_generations
+
+            dense = unpack_generations(self.state)
+        else:
+            dense = bitpack.unpack(self.state) if self._packed else self.state
         if max_shape is not None:
             dense = _downsample_max(dense, max_shape)
         return np.asarray(dense)
@@ -365,6 +390,10 @@ class Engine:
         space but are not population (they do not excite neighbors)."""
         if self._packed:
             return bitpack.population(self.state)
+        if self._gen_packed:
+            from .ops.packed_generations import population_packed_generations
+
+            return population_packed_generations(self.state)
         cells = (self._state == 1) if self._generations else self._state
         return int(np.asarray(jnp.sum(cells, axis=-1, dtype=jnp.uint32)).sum())
 
@@ -389,7 +418,12 @@ class Engine:
         grid = jnp.asarray(np_grid)
         if tuple(grid.shape) != self.shape:
             raise ValueError(f"grid shape {grid.shape} != engine shape {self.shape}")
-        state = bitpack.pack(grid) if self._packed else grid
+        if self._gen_packed:
+            from .ops.packed_generations import pack_generations_for
+
+            state = pack_generations_for(grid, self.rule)
+        else:
+            state = bitpack.pack(grid) if self._packed else grid
         if self.mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, self.mesh)
         if self._sparse is not None:

@@ -1,0 +1,138 @@
+"""Bit-plane packed stepper for the Generations (multi-state) family.
+
+The dense Generations path (ops/generations.py) pays one byte per cell of
+HBM traffic; this path stores the state number in ``b = ceil(log2(C))``
+bit-planes packed 32 cells/word — Brian's Brain (C=3) moves 4x fewer
+bytes per generation and every operation is 32-cell-wide uint32 bitwise
+arithmetic on the VPU, exactly like the binary SWAR path it reuses:
+
+- the *alive* plane (state == 1: low bit set, all higher bits clear) runs
+  through the same neighbor-plane extraction + carry-save adder network
+  as ops/packed.py (only state 1 excites neighbors);
+- birth/survival masks come from the same count bit-plane equality nets;
+- dying cells age by a plane-wise increment (half-adder carry chain, +1
+  per generation) with an equality net zeroing cells that reach C — the
+  ``(state + 1) % C`` of the dense path, bit-sliced.
+
+Single-device path; the sharded Generations runner keeps the byte layout
+(halo strips of a (b, h, wp) stack would need per-plane exchange — not
+worth it until a real multi-chip Generations workload exists). Bit-identity
+with the dense stepper is enforced in tests/test_packed_generations.py.
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generations import GenRule
+from . import bitpack
+from ._jit import optionally_donated
+from .packed import _count_eq, bit_sliced_sum, neighbor_planes
+from .stencil import Topology
+
+
+def n_planes(states: int) -> int:
+    """Bit-planes needed to store states 0..C-1."""
+    return max(1, (states - 1).bit_length())
+
+
+def pack_generations_for(grid: jax.Array, rule: GenRule) -> jax.Array:
+    """(H, W) uint8 state grid -> (b, H, W/32) uint32 bit-plane stack.
+
+    The plane count comes from the rule (b = n_planes(rule.states)), not
+    from the values present, so the stack shape is static per rule.
+    """
+    grid = jnp.asarray(grid, dtype=jnp.uint8)
+    b = n_planes(rule.states)
+    planes = [bitpack.pack((grid >> i) & 1) for i in range(b)]
+    return jnp.stack(planes)
+
+
+def unpack_generations(planes: jax.Array) -> jax.Array:
+    """(b, H, W/32) bit-plane stack -> (H, W) uint8 state grid."""
+    b = planes.shape[0]
+    out = None
+    for i in range(b):
+        part = bitpack.unpack(planes[i]).astype(jnp.uint8) << i
+        out = part if out is None else out | part
+    return out
+
+
+def alive_plane(planes: jax.Array) -> jax.Array:
+    """(H, W/32) plane that is set exactly where state == 1."""
+    higher = reduce(jnp.bitwise_or, [planes[i] for i in range(1, planes.shape[0])],
+                    jnp.zeros_like(planes[0]))
+    return planes[0] & ~higher
+
+
+def _mask_plane(bits: List[jax.Array], counts, like: jax.Array) -> jax.Array:
+    acc = jnp.zeros_like(like)
+    for n in sorted(counts):
+        acc = acc | _count_eq(bits, n)
+    return acc
+
+
+def _step_plane_list(plist, rule: GenRule, topology: Topology):
+    """One generation on a tuple of b (H, W/32) planes (no stack copies —
+    fori_loop carries the planes as a pytree)."""
+    b = len(plist)
+    nonzero = reduce(jnp.bitwise_or, plist)
+    higher = reduce(jnp.bitwise_or, plist[1:], jnp.zeros_like(plist[0]))
+    alive = plist[0] & ~higher  # state == 1: low bit set, higher clear
+
+    bits = bit_sliced_sum(neighbor_planes(alive, topology))
+    born_p = _mask_plane(bits, rule.born, alive)
+    keep_p = _mask_plane(bits, rule.survive, alive)
+
+    kept = alive & keep_p
+    one = (~nonzero & born_p) | kept     # cells whose next state is 1
+    aging = nonzero & ~kept              # state+1 (mod C) for everyone else alive-ish
+
+    # plane-wise +1: half-adder carry chain
+    carry = ~jnp.zeros_like(plist[0])
+    inc: List[jax.Array] = []
+    for p in plist:
+        inc.append(p ^ carry)
+        carry = p & carry
+    C = rule.states
+    if C != (1 << b):
+        # cells that aged to exactly C die (C == 2**b wraps via dropped carry)
+        eq_c = reduce(jnp.bitwise_and,
+                      [inc[i] if (C >> i) & 1 else ~inc[i] for i in range(b)])
+        inc = [p & ~eq_c for p in inc]
+
+    out = [aging & inc[i] for i in range(b)]
+    out[0] = out[0] | one
+    return tuple(out)
+
+
+def step_planes(planes: jax.Array, rule: GenRule, topology: Topology) -> jax.Array:
+    """One generation on a (b, H, W/32) bit-plane stack."""
+    b = planes.shape[0]
+    return jnp.stack(_step_plane_list(
+        tuple(planes[i] for i in range(b)), rule, topology))
+
+
+@optionally_donated("planes")
+def multi_step_packed_generations(
+    planes: jax.Array,
+    n: jax.Array,
+    *,
+    rule: GenRule,
+    topology: Topology = Topology.TORUS,
+) -> jax.Array:
+    """``n`` generations on a (b, H, W/32) stack in one jitted fori_loop."""
+    b = planes.shape[0]
+    body = lambda _, s: _step_plane_list(s, rule, topology)
+    out = jax.lax.fori_loop(0, n, body, tuple(planes[i] for i in range(b)))
+    return jnp.stack(out)
+
+
+def population_packed_generations(planes: jax.Array) -> int:
+    """Live-cell count (state == 1 only, matching Engine.population)."""
+    return int(bitpack.population(alive_plane(planes)))

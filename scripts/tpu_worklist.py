@@ -192,9 +192,39 @@ def _rule_child(rule_name: str, side: int) -> dict:
         s = run(s, gens, rule=rule, topology=Topology.TORUS)
         _sync_scalar(s)
         best = max(best, side * side * gens / (time.perf_counter() - t0))
-    return {"ok": identical, "bit_identical_vs_cpu": identical,
-            "rule": rule.notation, "side": side,
-            "cell_updates_per_sec": best, "platform": dev.platform}
+    out = {"ok": identical, "bit_identical_vs_cpu": identical,
+           "rule": rule.notation, "side": side,
+           "cell_updates_per_sec": best, "platform": dev.platform}
+
+    if not isinstance(rule, LtLRule):
+        # bit-plane packed path: on-chip identity vs dense + its own rate
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            multi_step_packed_generations,
+            pack_generations_for,
+            unpack_generations,
+        )
+
+        small_j = jnp.asarray(small)
+        # `got` above is the same 16-gen dense program on the same device
+        got_p = unpack_generations(multi_step_packed_generations(
+            pack_generations_for(small_j, rule), 16, rule=rule,
+            topology=Topology.TORUS))
+        out["planes_bit_identical"] = _device_equal(got_p, got)
+        out["ok"] = out["ok"] and out["planes_bit_identical"]
+        p = pack_generations_for(big, rule)
+        p = multi_step_packed_generations(p, 4, rule=rule,
+                                          topology=Topology.TORUS, donate=True)
+        _sync_scalar(p)
+        pbest = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            p = multi_step_packed_generations(p, gens, rule=rule,
+                                              topology=Topology.TORUS,
+                                              donate=True)
+            _sync_scalar(p)
+            pbest = max(pbest, side * side * gens / (time.perf_counter() - t0))
+        out["planes_cell_updates_per_sec"] = pbest
+    return out
 
 
 def child_ltl_bosco() -> dict:
