@@ -50,6 +50,17 @@ def pack_np(state: np.ndarray) -> np.ndarray:
     return by.reshape(h, wp, 4).view(np.dtype("<u4")).reshape(h, wp)
 
 
+def unpack_np(packed: np.ndarray) -> np.ndarray:
+    """Host-side (H, W/32) uint32 -> (H, W) uint8, inverse of :func:`pack_np`.
+
+    Lets checkpoint/IO paths stay in the 1-bit/cell layout end to end —
+    at 65536² the packed words are 512 MB where the dense grid is 4.3 GB.
+    """
+    h, wp = packed.shape
+    by = np.ascontiguousarray(packed, dtype="<u4").view(np.uint8).reshape(h, wp * 4)
+    return np.unpackbits(by, axis=-1, bitorder="little")
+
+
 def unpack(packed: jax.Array) -> jax.Array:
     """(H, W/32) uint32 -> (H, W) uint8 in {0,1}."""
     h, wp = packed.shape

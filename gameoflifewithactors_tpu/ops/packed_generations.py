@@ -63,6 +63,18 @@ def unpack_generations(planes: jax.Array) -> jax.Array:
     return out
 
 
+def unpack_generations_np(planes: np.ndarray) -> np.ndarray:
+    """Host-side (b, H, W/32) stack -> (H, W) uint8, the checkpoint-format
+    twin of :func:`unpack_generations` — keeps the plane-encoding contract
+    (plain binary of the state value, LSB plane first) in this module."""
+    out = None
+    for i in range(planes.shape[0]):
+        part = (bitpack.unpack_np(np.asarray(planes[i], dtype=np.uint32))
+                << i).astype(np.uint8)
+        out = part if out is None else out | part
+    return out
+
+
 def alive_plane(planes: jax.Array) -> jax.Array:
     """(H, W/32) plane that is set exactly where state == 1."""
     higher = reduce(jnp.bitwise_or, [planes[i] for i in range(1, planes.shape[0])],

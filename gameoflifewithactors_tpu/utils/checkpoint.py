@@ -19,37 +19,55 @@ from jax.sharding import Mesh
 
 from ..engine import Engine
 from ..models.generations import parse_any
+from ..ops import bitpack
 from ..ops.stencil import Topology
 
-FORMAT_VERSION = 2  # v2 adds the multistate (1 byte/cell) Generations layout
-_READABLE_VERSIONS = (1, 2)  # v1 files (binary, packbits) load unchanged
+FORMAT_VERSION = 3  # v3 adds device-layout checkpoints (no dense detour)
+_READABLE_VERSIONS = (1, 2, 3)  # older files load unchanged
 
 
 def save(engine: Engine, path: "str | Path") -> Path:
-    """Write the engine's exact state; returns the path written."""
+    """Write the engine's exact state; returns the path written.
+
+    Packed engines (binary bitboards and Generations bit-plane stacks)
+    save their device layout directly — the v3 "packed32"/"genplanes32"
+    layouts — so no dense copy is ever materialised: checkpointing a
+    65536² universe moves 512 MB of words, not a 4.3 GB byte grid
+    (device-side unpack + host gather, which is what snapshot() costs).
+    Byte-layout engines keep the v1 (packbits) / v2 (multistate cells)
+    forms. All versions reload onto any mesh/backend.
+    """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    grid = engine.snapshot()
-    multistate = bool(grid.max(initial=0) > 1)  # Generations states
-    meta = dict(
-        # binary/packbits files keep the v1 stamp (layout unchanged, old
-        # readers still load them); only the multistate layout gets the
-        # current format version, so a future bump propagates from the
-        # constant instead of silently drifting from it
-        version=FORMAT_VERSION if multistate else 1,
+    base = dict(
         rule=engine.rule.notation,
         topology=engine.topology.value,
         generation=engine.generation,
         shape=list(engine.shape),
-        multistate=multistate,
     )
     with open(path, "wb") as f:
-        if multistate:
-            # 1 byte/cell: Generations cells carry dying-state values
-            np.savez_compressed(f, cells=grid, meta=json.dumps(meta))
+        if engine._packed:
+            meta = dict(version=FORMAT_VERSION, layout="packed32",
+                        multistate=False, **base)
+            np.savez_compressed(
+                f, words=np.asarray(engine.state), meta=json.dumps(meta))
+        elif getattr(engine, "_gen_packed", False):
+            meta = dict(version=FORMAT_VERSION, layout="genplanes32",
+                        multistate=True, **base)
+            np.savez_compressed(
+                f, planes=np.asarray(engine.state), meta=json.dumps(meta))
         else:
-            # packbits: 1 bit/cell on disk regardless of engine backend
-            np.savez_compressed(f, bits=np.packbits(grid, axis=1), meta=json.dumps(meta))
+            grid = engine.snapshot()
+            multistate = bool(grid.max(initial=0) > 1)  # Generations states
+            # byte-layout files keep their historical stamps (v1 binary
+            # packbits / v2 multistate cells) so old readers still load them
+            meta = dict(version=2 if multistate else 1,
+                        multistate=multistate, **base)
+            if multistate:
+                np.savez_compressed(f, cells=grid, meta=json.dumps(meta))
+            else:
+                np.savez_compressed(f, bits=np.packbits(grid, axis=1),
+                                    meta=json.dumps(meta))
     return path
 
 
@@ -62,7 +80,15 @@ def load_grid(path: "str | Path") -> Tuple[np.ndarray, dict]:
                 f"unsupported checkpoint version {meta.get('version')!r} in {path}"
             )
         h, w = meta["shape"]
-        if meta.get("multistate"):
+        layout = meta.get("layout")
+        if layout == "packed32":
+            grid = bitpack.unpack_np(np.asarray(z["words"], dtype=np.uint32))[:, :w]
+        elif layout == "genplanes32":
+            from ..ops.packed_generations import unpack_generations_np
+
+            grid = unpack_generations_np(
+                np.asarray(z["planes"], dtype=np.uint32))[:, :w]
+        elif meta.get("multistate"):
             grid = np.asarray(z["cells"], dtype=np.uint8)
         else:
             grid = np.unpackbits(z["bits"], axis=1)[:, :w].astype(np.uint8)

@@ -155,14 +155,20 @@ def test_binary_rules_reject_multistate_grids():
 def test_checkpoint_version_stamp_per_layout(tmp_path):
     from gameoflifewithactors_tpu.utils import checkpoint as ckpt
 
+    # packed engines write the v3 device layouts (no dense detour)
     e = Engine(np.zeros((8, 32), np.uint8), "B3/S23")
-    ckpt.save(e, tmp_path / "bin.npz")
-    assert ckpt.load_grid(tmp_path / "bin.npz")[1]["version"] == 1
-
+    meta = ckpt.load_grid(ckpt.save(e, tmp_path / "bin.npz"))[1]
+    assert (meta["version"], meta["layout"]) == (3, "packed32")
     g = np.zeros((8, 32), np.uint8); g[2, 2] = 2
     e2 = Engine(g, "B2/S/C3")
-    ckpt.save(e2, tmp_path / "multi.npz")
-    assert ckpt.load_grid(tmp_path / "multi.npz")[1]["version"] == 2
+    meta = ckpt.load_grid(ckpt.save(e2, tmp_path / "multi.npz"))[1]
+    assert (meta["version"], meta["layout"]) == (3, "genplanes32")
+
+    # byte-layout engines keep the historical stamps old readers expect
+    e3 = Engine(np.zeros((8, 32), np.uint8), "B3/S23", backend="dense")
+    assert ckpt.load_grid(ckpt.save(e3, tmp_path / "d1.npz"))[1]["version"] == 1
+    e4 = Engine(g, "B2/S/C3", backend="dense")
+    assert ckpt.load_grid(ckpt.save(e4, tmp_path / "d2.npz"))[1]["version"] == 2
 
 
 @pytest.mark.parametrize("k", [1, 2, 3, 5, 7, 11, 15, 16, 31])

@@ -92,7 +92,7 @@ def test_engine_routes_generations_to_bit_planes():
     slow.step(17)
     np.testing.assert_array_equal(fast.snapshot(), slow.snapshot())
     assert fast.population() == slow.population()
-    # checkpoint round-trip goes through snapshot: multistate layout
+    # checkpoint round-trip exercises the v3 genplanes32 device layout
     import tempfile, os
     from gameoflifewithactors_tpu.utils import checkpoint as ckpt
     with tempfile.TemporaryDirectory() as d:
