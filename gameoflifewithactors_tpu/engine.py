@@ -93,8 +93,8 @@ class Engine:
                 f"backend={backend!r} is 3x3-binary-only; "
                 f"{type(self.rule).__name__} rules ({self.rule.notation}) run "
                 "on their own steppers (backend='packed' is the bit-plane "
-                "stack for Generations, dense for LtL; backend='dense' is "
-                "the byte layout)"
+                "stack for Generations and the bit-sliced bitboard for LtL; "
+                "backend='dense' is the byte layout)"
             )
         self.topology = topology
         self.mesh = mesh
@@ -108,8 +108,15 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
+        # LtL on one device with the packed backend: the state is a plain
+        # binary bitboard stepped by bit-sliced box sums (ops/packed_ltl.py),
+        # so it shares all the _packed machinery (snapshot/population/
+        # checkpoint); sharded LtL keeps the byte layout
+        self._ltl_packed = (self._ltl and mesh is None and backend == "packed"
+                            and self.shape[1] % bitpack.WORD == 0)
         self._packed = (backend in ("packed", "pallas", "sparse")
-                        and not (self._generations or self._ltl))
+                        and not (self._generations or self._ltl)
+                        ) or self._ltl_packed
         # Generations on one device with the packed backend: bit-plane
         # stack (ops/packed_generations.py), ~4x less HBM traffic than the
         # byte layout; sharded Generations keeps the dense layout
@@ -242,6 +249,12 @@ class Engine:
                     s, int(n), rule=self.rule, topology=self.topology,
                     interpret=interpret, donate=True,
                 )
+        elif self._ltl_packed:
+            from .ops.packed_ltl import multi_step_ltl_packed
+
+            self._run = lambda s, n: multi_step_ltl_packed(
+                s, n, rule=self.rule, topology=self.topology, donate=True
+            )
         elif self._ltl:
             from .ops.ltl import multi_step_ltl
 
@@ -276,8 +289,19 @@ class Engine:
         SWAR rate on a v5e) for single-device 3x3 binary rules at shapes it
         supports; the packed SWAR path everywhere else. Off 'packed',
         Generations rules take the bit-plane stack when the width packs
-        (% 32), the byte path otherwise; LtL rules are always dense."""
-        if mesh is not None or self._generations or self._ltl:
+        (% 32), the byte path otherwise; LtL picks bit-sliced packed on
+        TPU and the byte path elsewhere (see the platform note below)."""
+        if self._ltl:
+            # the bit-sliced LtL path wins on the TPU VPU but measured
+            # ~2.4x slower than the byte path under XLA's CPU lowering;
+            # pick per platform (explicit backend='packed' still forces it)
+            on_tpu = not pallas_stencil.default_interpret()
+            shape = np.shape(grid)
+            if (mesh is None and on_tpu and len(shape) == 2
+                    and shape[1] % bitpack.WORD == 0):
+                return "packed"
+            return "dense" if mesh is None else "packed"
+        if mesh is not None or self._generations:
             return "packed"
         shape = np.shape(grid)
         if len(shape) != 2 or shape[1] % bitpack.WORD:

@@ -1,0 +1,199 @@
+"""Bit-packed Larger-than-Life: bit-sliced box sums, 32 cells per word.
+
+The dense LtL path (ops/ltl.py) moves one int32 per cell through its
+log-tree window sums; here the grid stays a packed binary bitboard and
+the counts live in *bit-sliced* form — q uint32 planes holding bit q of
+every cell's count — so one bitwise op advances 32 cells:
+
+- the vertical (2r+1)-row window reuses the carry-save adder network of
+  the 3x3 SWAR path (ops/packed.bit_sliced_sum) over row-shifted planes;
+- the horizontal window is the same doubling tree ops/ltl.py uses, but
+  each "add" is a plane-wise ripple adder over bit-sliced numbers and
+  each "shift" is a cell shift with cross-word bit carries;
+- the B/S interval tests are bit-sliced subtract-borrow comparators
+  against the constant bounds.
+
+Counts reach (2r+1)^2 <= 225 for r <= 7, so numbers stay within 8
+planes. Cell shifts honor the topology exactly like the dense pad:
+TORUS wraps (word rolls + bit carries), DEAD shifts in zeros.
+
+Single-device path (the sharded LtL runner keeps the byte layout, like
+sharded Generations). Bit-identity with ops/ltl.py is enforced in
+tests/test_packed_ltl.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.ltl import LtLRule
+from ._jit import optionally_donated
+from .packed import bit_sliced_sum
+from .stencil import Topology
+
+_WORD = 32
+
+
+def _zero_cols(p: jax.Array, n: int, side: str) -> jax.Array:
+    """Zero the first/last ``n`` whole word-columns (DEAD shift fill)."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    mask = cols < n if side == "lo" else cols >= p.shape[1] - n
+    return jnp.where(mask, jnp.uint32(0), p)
+
+
+def _zero_rows(p: jax.Array, n: int, side: str) -> jax.Array:
+    rows = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    mask = rows < n if side == "lo" else rows >= p.shape[0] - n
+    return jnp.where(mask, jnp.uint32(0), p)
+
+
+def vshift(p: jax.Array, d: int, topology: Topology) -> jax.Array:
+    """Plane whose row r holds the cells of row r - d (d may be negative)."""
+    if d == 0:
+        return p
+    out = jnp.roll(p, d, axis=0)
+    if topology is not Topology.TORUS:
+        out = _zero_rows(out, abs(d), "lo" if d > 0 else "hi")
+    return out
+
+
+def hshift_west(p: jax.Array, d: int, topology: Topology) -> jax.Array:
+    """Plane whose column c holds the cell at column c - d (d >= 0): the
+    value ``d`` cells to the west, with cross-word bit carries."""
+    q, s = divmod(d, _WORD)
+    if q:
+        p = jnp.roll(p, q, axis=1)
+        if topology is not Topology.TORUS:
+            p = _zero_cols(p, q, "lo")
+    if s:
+        left = jnp.roll(p, 1, axis=1)
+        if topology is not Topology.TORUS:
+            left = _zero_cols(left, 1, "lo")
+        p = (p << s) | (left >> (_WORD - s))
+    return p
+
+
+def hshift_east(p: jax.Array, d: int, topology: Topology) -> jax.Array:
+    """Plane whose column c holds the cell at column c + d (d >= 0)."""
+    q, s = divmod(d, _WORD)
+    if q:
+        p = jnp.roll(p, -q, axis=1)
+        if topology is not Topology.TORUS:
+            p = _zero_cols(p, q, "hi")
+    if s:
+        right = jnp.roll(p, -1, axis=1)
+        if topology is not Topology.TORUS:
+            right = _zero_cols(right, 1, "hi")
+        p = (p >> s) | (right << (_WORD - s))
+    return p
+
+
+def bs_add(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> List[jax.Array]:
+    """Ripple add of two bit-sliced numbers (lists of planes, LSB first)."""
+    zero = jnp.zeros_like(a[0] if len(a) else b[0])
+    n = max(len(a), len(b))
+    out: List[jax.Array] = []
+    carry = zero
+    for i in range(n):
+        x = a[i] if i < len(a) else zero
+        y = b[i] if i < len(b) else zero
+        s = x ^ y
+        out.append(s ^ carry)
+        carry = (x & y) | (s & carry)
+    out.append(carry)
+    return out
+
+
+def bs_sub_bit(a: Sequence[jax.Array], bit: jax.Array) -> List[jax.Array]:
+    """a - bit for a one-plane subtrahend; caller guarantees no underflow."""
+    out = [a[0] ^ bit]
+    borrow = ~a[0] & bit
+    for i in range(1, len(a)):
+        out.append(a[i] ^ borrow)
+        borrow = ~a[i] & borrow
+    return out
+
+
+def bs_ge(a: Sequence[jax.Array], c: int) -> jax.Array:
+    """Plane set where the bit-sliced number a >= the Python constant c."""
+    if c <= 0:
+        return ~jnp.zeros_like(a[0])
+    if c >= (1 << len(a)):
+        return jnp.zeros_like(a[0])
+    borrow = jnp.zeros_like(a[0])
+    for i, p in enumerate(a):  # compute a - c; a >= c iff no final borrow
+        if (c >> i) & 1:
+            borrow = ~p | borrow
+        else:
+            borrow = ~p & borrow
+    return ~borrow
+
+
+def _one_sided_sum_bs(num: List[jax.Array], r: int, topology: Topology,
+                      shift) -> List[jax.Array]:
+    """sum_{d=1..r} shift(num, d): a doubling tree that only ever shifts in
+    ONE direction. That one-sidedness is what makes DEAD topology exact:
+    zero-fill from a shift then always coincides with a genuinely
+    beyond-edge (all-dead) contribution. (A centered tree that pre-shifts
+    east and recenters west drops real west-edge data first and back-fills
+    zeros — the bug this replaced.)"""
+    pows = {1: [shift(p, 1, topology) for p in num]}
+    m = 1
+    while 2 * m <= r:
+        cur = pows[m]
+        pows[2 * m] = bs_add(cur, [shift(p, m, topology) for p in cur])
+        m *= 2
+    acc = None
+    offset = 0
+    for p2 in sorted(pows, reverse=True):  # greedy binary decomposition of r
+        while r - offset >= p2:
+            piece = ([shift(pl, offset, topology) for pl in pows[p2]]
+                     if offset else pows[p2])
+            acc = piece if acc is None else bs_add(acc, piece)
+            offset += p2
+    return acc
+
+
+def _sliding_sum_bs(num: List[jax.Array], k: int, topology: Topology) -> List[jax.Array]:
+    """Width-``k`` horizontal sliding sum of a bit-sliced number, centered:
+    output(c) = sum_{d=-r..r} num(c+d) for k = 2r+1."""
+    r = (k - 1) // 2
+    if r == 0:
+        return list(num)
+    west = _one_sided_sum_bs(num, r, topology, hshift_west)
+    east = _one_sided_sum_bs(num, r, topology, hshift_east)
+    return bs_add(bs_add(west, east), num)
+
+
+def box_counts_packed(p: jax.Array, radius: int, topology: Topology) -> List[jax.Array]:
+    """Bit-sliced (2r+1)^2 box sums (center included) of a packed plane."""
+    k = 2 * radius + 1
+    col = bit_sliced_sum([vshift(p, d, topology) for d in range(-radius, radius + 1)])
+    return _sliding_sum_bs(col, k, topology)
+
+
+def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
+    """One generation on a (H, W/32) packed binary grid."""
+    counts = box_counts_packed(p, rule.radius, topology)
+    if not rule.middle:
+        counts = bs_sub_bit(counts, p)  # box sum >= p, no underflow
+    (b1, b2), (s1, s2) = rule.born, rule.survive
+    born = ~p & bs_ge(counts, b1) & ~bs_ge(counts, b2 + 1)
+    keep = p & bs_ge(counts, s1) & ~bs_ge(counts, s2 + 1)
+    return born | keep
+
+
+@optionally_donated("p")
+def multi_step_ltl_packed(
+    p: jax.Array,
+    n: jax.Array,
+    *,
+    rule: LtLRule,
+    topology: Topology = Topology.TORUS,
+) -> jax.Array:
+    """``n`` generations on a packed grid in one jitted fori_loop."""
+    body = lambda _, s: step_ltl_packed(s, rule, topology)
+    return jax.lax.fori_loop(0, n, body, p)

@@ -99,7 +99,7 @@ def load_engine(
     path: "str | Path",
     *,
     mesh: Optional[Mesh] = None,
-    backend: str = "packed",
+    backend: str = "auto",
 ) -> Engine:
     """Rebuild an Engine bit-exactly from a checkpoint (any mesh/backend)."""
     grid, meta = load_grid(path)

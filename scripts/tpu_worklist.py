@@ -153,6 +153,21 @@ def child_pallas_autotune() -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def _bench_rate(step, state, side: int, gens: int):
+    """Shared measurement protocol: warm 4 gens, then best of 2 timed reps
+    of ``gens`` generations (>= 512 so the ~65 ms/dispatch tunnel latency
+    doesn't dominate), each closed by a scalar readback."""
+    state = step(state, 4)
+    _sync_scalar(state)
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        state = step(state, gens)
+        _sync_scalar(state)
+        best = max(best, side * side * gens / (time.perf_counter() - t0))
+    return best
+
+
 def _rule_child(rule_name: str, side: int) -> dict:
     """On-chip bit-identity vs the CPU backend + measured rate (dense path)."""
     import jax
@@ -181,22 +196,30 @@ def _rule_child(rule_name: str, side: int) -> dict:
     identical = _device_equal(got, jax.device_put(want, dev))
 
     big = jnp.asarray(rng.integers(0, n_states, size=(side, side), dtype=np.uint8))
-    s = run(big, 4, rule=rule, topology=Topology.TORUS)
-    _sync_scalar(s)
-    # >= 512 gens per rep: at ~65 ms/dispatch tunnel latency, short runs
-    # measure the tunnel, not the chip
     gens = 512
-    best = 0.0
-    for _ in range(2):
-        t0 = time.perf_counter()
-        s = run(s, gens, rule=rule, topology=Topology.TORUS)
-        _sync_scalar(s)
-        best = max(best, side * side * gens / (time.perf_counter() - t0))
+    best = _bench_rate(
+        lambda st, n: run(st, n, rule=rule, topology=Topology.TORUS), big,
+        side, gens)
     out = {"ok": identical, "bit_identical_vs_cpu": identical,
            "rule": rule.notation, "side": side,
            "cell_updates_per_sec": best, "platform": dev.platform}
 
-    if not isinstance(rule, LtLRule):
+    if isinstance(rule, LtLRule):
+        # bit-sliced packed path: on-chip identity vs dense + its own rate
+        # (auto routes LtL to packed on TPU only if this wins — evidence!)
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        small_j = jnp.asarray(small)
+        got_pk = bitpack.unpack(multi_step_ltl_packed(
+            bitpack.pack(small_j), 16, rule=rule, topology=Topology.TORUS))
+        out["packed_bit_identical"] = _device_equal(got_pk.astype(jnp.uint8), got)
+        out["ok"] = out["ok"] and out["packed_bit_identical"]
+        out["packed_cell_updates_per_sec"] = _bench_rate(
+            lambda st, n: multi_step_ltl_packed(
+                st, n, rule=rule, topology=Topology.TORUS, donate=True),
+            bitpack.pack(big), side, gens)
+    else:
         # bit-plane packed path: on-chip identity vs dense + its own rate
         from gameoflifewithactors_tpu.ops.packed_generations import (
             multi_step_packed_generations,
@@ -211,19 +234,10 @@ def _rule_child(rule_name: str, side: int) -> dict:
             topology=Topology.TORUS))
         out["planes_bit_identical"] = _device_equal(got_p, got)
         out["ok"] = out["ok"] and out["planes_bit_identical"]
-        p = pack_generations_for(big, rule)
-        p = multi_step_packed_generations(p, 4, rule=rule,
-                                          topology=Topology.TORUS, donate=True)
-        _sync_scalar(p)
-        pbest = 0.0
-        for _ in range(2):
-            t0 = time.perf_counter()
-            p = multi_step_packed_generations(p, gens, rule=rule,
-                                              topology=Topology.TORUS,
-                                              donate=True)
-            _sync_scalar(p)
-            pbest = max(pbest, side * side * gens / (time.perf_counter() - t0))
-        out["planes_cell_updates_per_sec"] = pbest
+        out["planes_cell_updates_per_sec"] = _bench_rate(
+            lambda st, n: multi_step_packed_generations(
+                st, n, rule=rule, topology=Topology.TORUS, donate=True),
+            pack_generations_for(big, rule), side, gens)
     return out
 
 
