@@ -16,7 +16,7 @@ Items:
   bench_packed      north-star: bench.py packed @16384² (persists best)
   pallas_identity   native-Mosaic kernel bit-identity vs XLA SWAR on-chip
   pallas_autotune   sweep (block_rows, gens_per_call), record best rate
-  ltl_bosco         LtL log-tree path: on-chip bit-identity vs CPU + rate
+  ltl_bosco         LtL: on-chip identity vs CPU + dense and bit-sliced rates
   generations_brain Generations path: on-chip bit-identity vs CPU + rate
   ltl_lowering      compiled-HLO evidence the LtL step lowers conv-free (VPU tree)
   config5_sparse    65536² Gosper gun sparse on the chip

@@ -53,6 +53,15 @@ def main() -> None:
         jnp.asarray(packed), gens, rule=CONWAY, topology=Topology.TORUS))
     np.testing.assert_array_equal(got, want)
     assert got.sum() > 0  # the glider is alive somewhere
+
+    # communication-avoiding runner across REAL process boundaries: one
+    # depth-g exchange per g generations, still bit-identical
+    g = 8
+    assert gens % g == 0
+    deep = sharded.make_multi_step_packed_deep(
+        mesh, CONWAY, Topology.TORUS, gens_per_exchange=g)
+    got_deep = multihost.gather_global(deep(p, gens // g))  # p still live
+    np.testing.assert_array_equal(got_deep, want)
     print(f"MULTIHOST-OK proc={pid}/{n_procs} devices={len(jax.devices())}",
           flush=True)
 
