@@ -108,21 +108,21 @@ class Engine:
         self.shape: Tuple[int, int] = tuple(grid.shape)
         self.generation = 0
 
-        # LtL on one device with the packed backend: the state is a plain
-        # binary bitboard stepped by bit-sliced box sums (ops/packed_ltl.py),
-        # so it shares all the _packed machinery (snapshot/population/
-        # checkpoint); sharded LtL keeps the byte layout
-        self._ltl_packed = (self._ltl and mesh is None and backend == "packed"
-                            and self.shape[1] % bitpack.WORD == 0)
+        # LtL with the packed backend: the state is a plain binary
+        # bitboard stepped by bit-sliced box sums (ops/packed_ltl.py), so
+        # it shares all the _packed machinery (snapshot/population/
+        # checkpoint); sharded tiles exchange r-row + 1-word halos
+        _ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
+        _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
+        self._ltl_packed = self._ltl and backend == "packed" and _packs
         self._packed = (backend in ("packed", "pallas", "sparse")
                         and not (self._generations or self._ltl)
                         ) or self._ltl_packed
-        # Generations on one device with the packed backend: bit-plane
-        # stack (ops/packed_generations.py), ~4x less HBM traffic than the
-        # byte layout; sharded Generations keeps the dense layout
-        self._gen_packed = (self._generations and mesh is None
-                            and backend == "packed"
-                            and self.shape[1] % bitpack.WORD == 0)
+        # Generations with the packed backend: bit-plane stack
+        # (ops/packed_generations.py), ~4x less HBM traffic than the byte
+        # layout; shards as P(None, x, y) with per-plane halo exchange
+        self._gen_packed = (self._generations and backend == "packed"
+                            and _packs)
         self._sparse = None
         self._flags = None
         if mesh is not None:
@@ -135,7 +135,8 @@ class Engine:
             # user's grid shape, not the packed word shape
             nx = mesh.shape[mesh_lib.ROW_AXIS]
             ny = mesh.shape[mesh_lib.COL_AXIS]
-            wq = bitpack.WORD * ny if self._packed else ny
+            wq = (bitpack.WORD * ny if self._packed or self._gen_packed
+                  else ny)
             if self.shape[0] % nx or self.shape[1] % wq:
                 raise ValueError(
                     f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
@@ -158,12 +159,19 @@ class Engine:
                         f"smaller than the rule radius {r}: halo exchange "
                         "needs depth <= tile size; use fewer devices"
                     )
-                self._run = sharded.make_multi_step_ltl(mesh, self.rule, topology,
-                                                        donate=True)
+                if self._ltl_packed:
+                    self._run = sharded.make_multi_step_ltl_packed(
+                        mesh, self.rule, topology, donate=True)
+                else:
+                    self._run = sharded.make_multi_step_ltl(
+                        mesh, self.rule, topology, donate=True)
             elif self._generations:
-                self._run = sharded.make_multi_step_generations(
-                    mesh, self.rule, topology, donate=True
-                )
+                if self._gen_packed:
+                    self._run = sharded.make_multi_step_generations_packed(
+                        mesh, self.rule, topology, donate=True)
+                else:
+                    self._run = sharded.make_multi_step_generations(
+                        mesh, self.rule, topology, donate=True)
             elif backend == "sparse":
                 if sparse_opts:
                     warnings.warn(
@@ -297,10 +305,10 @@ class Engine:
             # pick per platform (explicit backend='packed' still forces it)
             on_tpu = not pallas_stencil.default_interpret()
             shape = np.shape(grid)
-            if (mesh is None and on_tpu and len(shape) == 2
+            if (on_tpu and len(shape) == 2
                     and shape[1] % bitpack.WORD == 0):
                 return "packed"
-            return "dense" if mesh is None else "packed"
+            return "dense"
         if mesh is not None or self._generations:
             return "packed"
         shape = np.shape(grid)
@@ -381,7 +389,21 @@ class Engine:
         itemsize = 4 if self._packed else 1
         depth = self.rule.radius if self._ltl else 1  # strip depth in rows/cols
         g = self.gens_per_exchange
-        if g > 1:
+        if self._ltl_packed:
+            # r halo rows of packed words + ONE halo word per side
+            # (32 >= r cells), on a (h + 2r)-row-extended tile
+            row_strip = depth * (wq // ny) * itemsize
+            col_strip = (h // nx + 2 * depth) * itemsize
+        elif self._gen_packed:
+            # b uint32 bit-planes, each with 1-row / 1-word halos
+            from .ops.packed_generations import n_planes
+
+            b = n_planes(self.rule.states)
+            wq = w // bitpack.WORD
+            itemsize = 4
+            row_strip = b * (wq // ny) * itemsize
+            col_strip = b * (h // nx + 2) * itemsize
+        elif g > 1:
             # communication-avoiding runner: one exchange of g-deep row
             # strips + 1-word column strips per g generations, amortized
             row_strip = g * (wq // ny) * itemsize

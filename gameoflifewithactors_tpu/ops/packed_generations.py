@@ -89,15 +89,15 @@ def _mask_plane(bits: List[jax.Array], counts, like: jax.Array) -> jax.Array:
     return acc
 
 
-def _step_plane_list(plist, rule: GenRule, topology: Topology):
-    """One generation on a tuple of b (H, W/32) planes (no stack copies —
-    fori_loop carries the planes as a pytree)."""
+def _alive_of(plist):
+    higher = reduce(jnp.bitwise_or, plist[1:], jnp.zeros_like(plist[0]))
+    return plist[0] & ~higher  # state == 1: low bit set, higher clear
+
+
+def _transition(plist, alive, bits, rule: GenRule):
+    """Next-generation planes from (state planes, alive plane, count bits)."""
     b = len(plist)
     nonzero = reduce(jnp.bitwise_or, plist)
-    higher = reduce(jnp.bitwise_or, plist[1:], jnp.zeros_like(plist[0]))
-    alive = plist[0] & ~higher  # state == 1: low bit set, higher clear
-
-    bits = bit_sliced_sum(neighbor_planes(alive, topology))
     born_p = _mask_plane(bits, rule.born, alive)
     keep_p = _mask_plane(bits, rule.survive, alive)
 
@@ -121,6 +121,26 @@ def _step_plane_list(plist, rule: GenRule, topology: Topology):
     out = [aging & inc[i] for i in range(b)]
     out[0] = out[0] | one
     return tuple(out)
+
+
+def _step_plane_list(plist, rule: GenRule, topology: Topology):
+    """One generation on a tuple of b (H, W/32) planes (no stack copies —
+    fori_loop carries the planes as a pytree)."""
+    alive = _alive_of(plist)
+    bits = bit_sliced_sum(neighbor_planes(alive, topology))
+    return _transition(plist, alive, bits, rule)
+
+
+def step_planes_ext(ext_list, rule: GenRule):
+    """One generation from b halo-extended (h+2, wp+2) planes -> interior
+    (h, wp) plane tuple. Halos come from the caller (sharded ppermute)."""
+    from .packed import neighbor_planes_ext
+
+    alive_ext = _alive_of(ext_list)
+    center, nplanes = neighbor_planes_ext(alive_ext)  # center = interior alive
+    bits = bit_sliced_sum(nplanes)
+    interior = tuple(p[1:-1, 1:-1] for p in ext_list)
+    return _transition(interior, center, bits, rule)
 
 
 def step_planes(planes: jax.Array, rule: GenRule, topology: Topology) -> jax.Array:

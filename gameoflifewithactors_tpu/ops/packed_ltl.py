@@ -175,15 +175,32 @@ def box_counts_packed(p: jax.Array, radius: int, topology: Topology) -> List[jax
     return _sliding_sum_bs(col, k, topology)
 
 
-def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
-    """One generation on a (H, W/32) packed binary grid."""
-    counts = box_counts_packed(p, rule.radius, topology)
+def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> jax.Array:
+    """Next-generation plane from the alive plane + bit-sliced box counts."""
     if not rule.middle:
         counts = bs_sub_bit(counts, p)  # box sum >= p, no underflow
     (b1, b2), (s1, s2) = rule.born, rule.survive
     born = ~p & bs_ge(counts, b1) & ~bs_ge(counts, b2 + 1)
     keep = p & bs_ge(counts, s1) & ~bs_ge(counts, s2 + 1)
     return born | keep
+
+
+def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
+    """One generation on a (H, W/32) packed binary grid."""
+    return _apply_intervals(p, box_counts_packed(p, rule.radius, topology), rule)
+
+
+def step_ltl_packed_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
+    """One generation from a halo-extended packed tile -> (h, wp) interior.
+
+    ``ext`` is (h + 2r, wp + 2): r halo *rows* top/bottom and one halo
+    *word* (32 >= r cells) left/right, materialised by the caller (the
+    sharded runner's ppermute exchange). Counts are computed with DEAD
+    closure on the slab — every interior cell's (2r+1)² box lies inside
+    the ext, so the closure never touches a real contribution."""
+    r = rule.radius
+    counts = [c[r:-r, 1:-1] for c in box_counts_packed(ext, r, Topology.DEAD)]
+    return _apply_intervals(ext[r:-r, 1:-1], counts, rule)
 
 
 @optionally_donated("p")

@@ -65,6 +65,21 @@ def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_A
     return jnp.concatenate([west, ext, east], axis=1)
 
 
+def exchange_halo_stack(stack: jax.Array, nx: int, ny: int, topology: Topology,
+                        depth: int = 1) -> jax.Array:
+    """(b, h, w) plane stack -> (b, h+2d, w+2d): the same two-phase trip as
+    :func:`exchange_halo`, but one ppermute per side carries ALL b planes
+    (payload (b, d, w)) instead of b separate sends — 4 collectives per
+    generation for the bit-plane Generations layout regardless of b."""
+    wrap = topology is Topology.TORUS
+    north = lax.ppermute(stack[:, -depth:, :], ROW_AXIS, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(stack[:, :depth, :], ROW_AXIS, _shift_perm(nx, -1, wrap))
+    ext = jnp.concatenate([north, stack, south], axis=1)
+    west = lax.ppermute(ext[:, :, -depth:], COL_AXIS, _shift_perm(ny, +1, wrap))
+    east = lax.ppermute(ext[:, :, :depth], COL_AXIS, _shift_perm(ny, -1, wrap))
+    return jnp.concatenate([west, ext, east], axis=2)
+
+
 def exchange_halo(tile: jax.Array, nx: int, ny: int, topology: Topology,
                   depth: int = 1) -> jax.Array:
     """Full two-phase exchange: (h, w) tile -> (h+2d, w+2d) haloed tile.

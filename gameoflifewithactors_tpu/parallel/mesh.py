@@ -159,6 +159,14 @@ def check_divisible(shape: Tuple[int, int], mesh: Mesh) -> None:
 
 
 def device_put_sharded_grid(grid: jax.Array, mesh: Mesh) -> jax.Array:
-    """Place a (possibly packed) grid onto the mesh with 2D tiling."""
+    """Place a grid onto the mesh with 2D spatial tiling.
+
+    Accepts (H, W) / (H, W/32) grids, or a (b, H, W/32) bit-plane stack
+    (Generations packed layout) whose leading plane axis is replicated.
+    """
+    if grid.ndim == 3:
+        check_divisible(grid.shape[1:], mesh)
+        return jax.device_put(
+            grid, NamedSharding(mesh, P(None, ROW_AXIS, COL_AXIS)))
     check_divisible(grid.shape, mesh)
     return jax.device_put(grid, grid_sharding(mesh))

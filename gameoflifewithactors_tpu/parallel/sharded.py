@@ -28,7 +28,7 @@ from ..models.rules import Rule
 from ..ops import packed as packed_ops
 from ..ops import stencil as stencil_ops
 from ..ops.stencil import Topology
-from .halo import exchange_cols, exchange_halo, exchange_rows
+from .halo import exchange_cols, exchange_halo, exchange_halo_stack, exchange_rows
 from .mesh import COL_AXIS, ROW_AXIS
 
 _SPEC = P(ROW_AXIS, COL_AXIS)
@@ -220,6 +220,59 @@ def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.
 
     return _make_runner(mesh, rule, topology, step_generations_ext, multi=True,
                         donate=donate)
+
+
+def make_multi_step_ltl_packed(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
+                               donate: bool = False) -> Callable:
+    """Sharded bit-sliced LtL on packed bitboards: per generation, each
+    tile exchanges r halo *rows* and one halo *word* (32 >= r cells — the
+    same asymmetric depth trick the communication-avoiding runner uses),
+    then steps via ops/packed_ltl.step_ltl_packed_ext. Jitted
+    ``(grid, n) -> grid`` on a (H, W/32) uint32 sharded grid."""
+    from ..ops.packed_ltl import step_ltl_packed_ext
+
+    r = rule.radius
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+
+    def generation(tile):
+        if tile.shape[0] < r:  # static shapes: caught at trace time
+            raise ValueError(
+                f"per-device tile height {tile.shape[0]} smaller than the "
+                f"rule radius {r}; use fewer mesh rows")
+        ext = exchange_cols(
+            exchange_rows(tile, nx, topology, depth=r), ny, topology, depth=1)
+        return step_ltl_packed_ext(ext, rule)
+
+    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
+    def _run(tile, n):
+        return jax.lax.fori_loop(0, n, lambda _, t: generation(t), tile)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step_generations_packed(
+    mesh: Mesh, rule, topology: Topology = Topology.TORUS,
+    donate: bool = False,
+) -> Callable:
+    """Sharded bit-plane Generations: the (b, H, W/32) plane stack shards
+    as P(None, 'x', 'y'); each generation moves ONE four-send halo trip
+    for all b planes (halo.exchange_halo_stack) and steps via
+    ops/packed_generations.step_planes_ext. Jitted ``(planes, n) -> planes``."""
+    from ..ops.packed_generations import n_planes, step_planes_ext
+
+    b = n_planes(rule.states)
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    spec3 = P(None, ROW_AXIS, COL_AXIS)
+
+    def generation(planes):
+        ext = exchange_halo_stack(planes, nx, ny, topology)
+        return jnp.stack(step_planes_ext([ext[i] for i in range(b)], rule))
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec3, P()), out_specs=spec3)
+    def _run(planes, n):
+        return jax.lax.fori_loop(0, n, lambda _, t: generation(t), planes)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
 
 
 def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS,

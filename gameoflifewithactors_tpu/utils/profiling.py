@@ -134,8 +134,16 @@ def measured_halo_bytes_per_gen(engine) -> int:
 
     if engine.mesh is None:
         return 0
-    if getattr(engine, "_ltl", False):
+    if getattr(engine, "_ltl_packed", False):
+        step1 = sharded.make_multi_step_ltl_packed(
+            engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_ltl", False):
         step1 = sharded.make_multi_step_ltl(engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_gen_packed", False):
+        step1 = sharded.make_multi_step_generations_packed(
+            engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)
     elif getattr(engine, "_generations", False):
         step1 = sharded.make_multi_step_generations(

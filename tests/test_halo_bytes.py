@@ -36,6 +36,9 @@ CASES = [
     ((2, 2), "dense", "B3/S23", Topology.DEAD),
     ((2, 4), "dense", "brain", Topology.TORUS),    # Generations, uint8 path
     ((2, 2), "dense", "R2,C0,M0,S3..8,B5..7", Topology.TORUS),  # LtL depth 2
+    # the packed multi-rule layouts (bit-planes / bit-sliced bitboards)
+    ((2, 4), "packed", "brain", Topology.TORUS),
+    ((2, 2), "packed", "R2,C0,M0,S3..8,B5..7", Topology.TORUS),
 ]
 
 

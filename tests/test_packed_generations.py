@@ -100,3 +100,21 @@ def test_engine_routes_generations_to_bit_planes():
         back = ckpt.load_engine(path)
         np.testing.assert_array_equal(back.snapshot(), fast.snapshot())
         assert back.generation == 17
+
+
+def test_sharded_bit_planes_match_single_device():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib, sharded
+
+    rule = parse_any("brain")
+    g = _soup(rule, shape=(64, 256), seed=31)
+    want = np.asarray(multi_step_generations(
+        jnp.asarray(g), 14, rule=rule, topology=Topology.TORUS))
+    m = mesh_lib.make_mesh((2, 4))
+    planes = pack_generations_for(jnp.asarray(g), rule)
+    planes = jax.device_put(
+        planes, NamedSharding(m, P(None, mesh_lib.ROW_AXIS, mesh_lib.COL_AXIS)))
+    run = sharded.make_multi_step_generations_packed(m, rule, Topology.TORUS)
+    got = np.asarray(unpack_generations(run(planes, 14)))
+    np.testing.assert_array_equal(got, want)

@@ -120,3 +120,31 @@ def test_engine_routes_ltl_to_packed():
     odd = Engine(_soup((64, 100), seed=2), "bosco", backend="packed")
     assert not odd._ltl_packed
     odd.step(2)
+
+
+class TestShardedPackedLtL:
+    @pytest.mark.parametrize("rule_s", ["bosco", "majority"])
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    def test_bit_identity_vs_single_device(self, rule_s, topology):
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib, sharded
+
+        rule = parse_ltl(rule_s)
+        g = _soup((64, 256), seed=len(rule_s), p=0.4)
+        want = np.asarray(multi_step_ltl(
+            jnp.asarray(g), 10, rule=rule, topology=topology))
+        m = mesh_lib.make_mesh((2, 4))
+        p = mesh_lib.device_put_sharded_grid(bitpack.pack(jnp.asarray(g)), m)
+        run = sharded.make_multi_step_ltl_packed(m, rule, topology)
+        got = np.asarray(bitpack.unpack(run(p, 10)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_tile_smaller_than_radius_raises(self):
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib, sharded
+
+        rule = parse_ltl("R7,C0,M1,S1..40,B1..40")
+        m = mesh_lib.make_mesh((8, 1))
+        p = mesh_lib.device_put_sharded_grid(
+            bitpack.pack(jnp.zeros((32, 32), jnp.uint8)), m)  # 4-row tiles
+        run = sharded.make_multi_step_ltl_packed(m, rule, Topology.TORUS)
+        with pytest.raises(ValueError, match="smaller than the rule radius"):
+            run(p, 1)
