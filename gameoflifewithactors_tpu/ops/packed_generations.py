@@ -14,10 +14,11 @@ arithmetic on the VPU, exactly like the binary SWAR path it reuses:
   per generation) with an equality net zeroing cells that reach C — the
   ``(state + 1) % C`` of the dense path, bit-sliced.
 
-Single-device path; the sharded Generations runner keeps the byte layout
-(halo strips of a (b, h, wp) stack would need per-plane exchange — not
-worth it until a real multi-chip Generations workload exists). Bit-identity
-with the dense stepper is enforced in tests/test_packed_generations.py.
+Shards too: parallel/sharded.make_multi_step_generations_packed moves the
+whole (b, h, wp) stack through ONE four-send halo trip per generation
+(halo.exchange_halo_stack) and steps via :func:`step_planes_ext`.
+Bit-identity with the dense stepper is enforced in
+tests/test_packed_generations.py.
 """
 
 from __future__ import annotations

@@ -17,8 +17,9 @@ Counts reach (2r+1)^2 <= 225 for r <= 7, so numbers stay within 8
 planes. Cell shifts honor the topology exactly like the dense pad:
 TORUS wraps (word rolls + bit carries), DEAD shifts in zeros.
 
-Single-device path (the sharded LtL runner keeps the byte layout, like
-sharded Generations). Bit-identity with ops/ltl.py is enforced in
+Shards too: parallel/sharded.make_multi_step_ltl_packed exchanges r halo
+rows plus one halo word per generation and steps via
+:func:`step_ltl_packed_ext`. Bit-identity with ops/ltl.py is enforced in
 tests/test_packed_ltl.py.
 """
 
