@@ -153,6 +153,14 @@ def make_multi_step_packed_deep(
     multi-host) this amortizes the per-collective latency g-fold for
     ~(2g/tile_rows) redundant compute.
 
+    Measured caveat (results/weak_scaling_cpu8_G.json): XLA's CPU backend
+    does not fuse the unrolled shrinking-slab chain the way it fuses the
+    per-generation runner, materializing ~20 slab-sized intermediates per
+    generation (~36x slower per-device on one CPU core). Use this runner
+    when per-collective latency is the bottleneck, not for single-host
+    throughput; cross-process bit-identity is proven in
+    tests/test_multihost.py.
+
     Returns jitted ``(grid, chunks) -> grid`` advancing ``chunks * g``
     generations (``chunks`` is a traced scalar; g is static). Bit-identity
     with make_multi_step_packed is enforced in tests/test_sharding.py.
