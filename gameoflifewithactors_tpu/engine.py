@@ -473,15 +473,7 @@ class Engine:
         if self.mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, self.mesh)
         if self._sparse is not None:
-            from .ops.sparse import SparseEngineState
-
-            self._sparse = SparseEngineState(
-                state, self.rule,
-                tile_rows=self._sparse.tile_rows,
-                tile_words=self._sparse.tile_words,
-                capacity=self._sparse.capacity,
-                topology=self._sparse.topology,
-            )
+            self._sparse = self._sparse.reseed(state)
         else:
             self._state = state
         if self._flags is not None:

@@ -42,7 +42,7 @@ from .stencil import Topology
 
 DEFAULT_TILE_ROWS = 32
 DEFAULT_TILE_WORDS = 4
-DEFAULT_CAPACITY = 256
+_MAX_ADAPTIVE_CAPACITY = 4096
 MAX_MAP_ENTRIES = 65536
 
 
@@ -131,22 +131,21 @@ def _build_sparse_step(
     capacity: int,
     topology: Topology = Topology.DEAD,
 ):
-    """Build the jitted (sparse_many, dense_once) pair for this config.
+    """Build the jitted ``sparse_many`` runner for this config.
 
     DEAD: the zero ring *is* the boundary. TORUS: the ring is refreshed
     with wrapped interior edges each generation (same whole-word halo
     mechanism as the sharded path's ppermute strips) and tile-activity
     dilation wraps, so seam-crossing ships work.
 
-    Returns ``(sparse_many, dense_once)``; SparseEngineState.step
-    orchestrates them. The common all-sparse case runs entirely on-device
-    in a ``while_loop`` that early-exits when the candidate count exceeds
-    ``capacity``; the host then dispatches one ``dense_once`` generation
-    and resumes. The loop body is scatter-only, so XLA updates the
-    (~0.5 GB at 65536²) grid in place — the earlier design's
-    ``lax.cond(sparse, dense)`` per generation blocked output aliasing
-    and paid a full-buffer copy every generation (measured 45 ms/gen vs
-    3 ms/gen at 32768² on CPU; VERDICT.md round-1 Weak #6).
+    SparseEngineState.step orchestrates this with the capacity-independent
+    :func:`_build_dense_once` fallback. The common all-sparse case runs
+    entirely on-device in a ``while_loop`` that early-exits when the
+    candidate count exceeds ``capacity``. The loop body is scatter-only,
+    so XLA updates the (~0.5 GB at 65536²) grid in place — the earlier
+    design's ``lax.cond(sparse, dense)`` per generation blocked output
+    aliasing and paid a full-buffer copy every generation (measured
+    45 ms/gen vs 3 ms/gen at 32768² on CPU; VERDICT.md round-1 Weak #6).
     """
     H, Wp = shape
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
@@ -210,10 +209,26 @@ def _build_sparse_step(
             cond_fn, body, carry_of(padded, active, 0))
         return padded, active, done
 
+    return sparse_many
+
+
+@lru_cache(maxsize=32)
+def _build_dense_once(
+    rule: Rule,
+    shape: Tuple[int, int],
+    tile_rows: int,
+    tile_words: int,
+    topology: Topology = Topology.DEAD,
+):
+    """One full-grid generation (the overflow fallback). Deliberately NOT
+    keyed on capacity: an adaptive engine that escalates must not
+    re-compile this O(grid) step per capacity level."""
+    H, Wp = shape
+    nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+    wrap = topology is Topology.TORUS
+
     @partial(jax.jit, donate_argnums=(0,))
     def dense_once(padded):
-        """One full-grid generation (the overflow fallback — already O(grid),
-        so the cond-free structure costs nothing extra here)."""
         if wrap:
             padded = _refresh_ring(padded)
         old = padded[1:-1, 1:-1]
@@ -226,7 +241,7 @@ def _build_sparse_step(
         padded = jax.lax.dynamic_update_slice(padded, new, (1, 1))
         return padded, changed
 
-    return sparse_many, dense_once
+    return dense_once
 
 
 class SparseEngineState:
@@ -239,7 +254,7 @@ class SparseEngineState:
         *,
         tile_rows: int | None = None,
         tile_words: int | None = None,
-        capacity: int = DEFAULT_CAPACITY,
+        capacity: int | None = None,
         topology: Topology = Topology.DEAD,
     ):
         H, Wp = packed.shape
@@ -248,6 +263,13 @@ class SparseEngineState:
         tile_rows = tile_rows or DEFAULT_TILE_ROWS
         tile_words = tile_words or DEFAULT_TILE_WORDS
         _tile_grid_shape(H, Wp, tile_rows, tile_words)  # validate
+        # capacity policy: an explicit value is FIXED (overflow -> one dense
+        # full-grid generation, as documented); None is adaptive — start
+        # near the seeded activity and double on overflow (each escalation
+        # is one extra compile, bounded by _MAX_ADAPTIVE_CAPACITY), so a
+        # mostly-sleeping universe never pays a 256-tile window batch per
+        # generation for 6 active tiles.
+        self._adaptive = capacity is None
         if 0 in rule.born:
             raise ValueError(
                 f"sparse backend cannot run B0 rules ({rule.notation}): every "
@@ -257,30 +279,64 @@ class SparseEngineState:
         self.rule = rule
         self.tile_rows = tile_rows
         self.tile_words = tile_words
-        self.capacity = capacity
         self.topology = topology
         self.shape = (H, Wp)
         self.padded = jnp.pad(packed, 1)
         self.active = initial_activity(self.padded, tile_rows, tile_words)
-        self._sparse_many, self._dense_once = _build_sparse_step(
-            rule, (H, Wp), tile_rows, tile_words, capacity, topology
+        if self._adaptive:
+            # 9x the seeded tiles covers the first dilations; pow2 keeps the
+            # lru-cached compile set small across escalations
+            want = max(32, 9 * int(jnp.sum(self.active)))
+            capacity = 1 << (want - 1).bit_length()
+            capacity = min(capacity, _MAX_ADAPTIVE_CAPACITY)
+        self._set_capacity(capacity)
+
+    def _set_capacity(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._sparse_many = _build_sparse_step(
+            self.rule, self.shape, self.tile_rows, self.tile_words,
+            capacity, self.topology
+        )
+        self._dense_once = _build_dense_once(
+            self.rule, self.shape, self.tile_rows, self.tile_words,
+            self.topology
         )
 
     def step(self, n: int = 1) -> None:
         """Advance ``n`` generations: the on-device while_loop runs sparse
-        generations until done or a capacity overflow; overflows fall back
-        to one dense full-grid generation and resume. The host reads one
-        scalar (generations completed) per dispatch — the price of keeping
-        the common path copy-free; all-sparse runs cost exactly one
-        dispatch + one scalar fetch regardless of ``n``."""
+        generations until done or a capacity overflow. Adaptive capacity
+        (the default) handles overflow by doubling and retrying — the
+        universe state is untouched (the loop's guard runs before the
+        over-capacity generation), so escalation costs one recompile, not
+        a correctness risk; at _MAX_ADAPTIVE_CAPACITY, and always for an
+        explicit fixed capacity, overflow falls back to one dense
+        full-grid generation and resumes. The host reads one scalar
+        (generations completed) per dispatch — the price of keeping the
+        common path copy-free; all-sparse runs cost exactly one dispatch
+        + one scalar fetch regardless of ``n``."""
         remaining = int(n)
         while remaining > 0:
             self.padded, self.active, done = self._sparse_many(
                 self.padded, self.active, remaining)
             remaining -= int(done)
             if remaining > 0:
+                if self._adaptive and self.capacity < _MAX_ADAPTIVE_CAPACITY:
+                    self._set_capacity(min(self.capacity * 2,
+                                           _MAX_ADAPTIVE_CAPACITY))
+                    continue
                 self.padded, self.active = self._dense_once(self.padded)
                 remaining -= 1
+
+    def reseed(self, packed: jax.Array) -> "SparseEngineState":
+        """A fresh state over ``packed`` with this state's configuration,
+        including whether capacity is adaptive — callers never need to
+        reconstruct the policy themselves (Engine.set_grid uses this)."""
+        return SparseEngineState(
+            packed, self.rule,
+            tile_rows=self.tile_rows, tile_words=self.tile_words,
+            capacity=None if self._adaptive else self.capacity,
+            topology=self.topology,
+        )
 
     @property
     def packed(self) -> jax.Array:

@@ -319,3 +319,35 @@ def test_sparse_auto_tiles_match_explicit_tiles():
     a.step(48)
     b.step(48)
     np.testing.assert_array_equal(np.asarray(a.packed), np.asarray(b.packed))
+
+
+def test_adaptive_capacity_starts_small_and_escalates():
+    # a small still patch: adaptive capacity starts near the activity...
+    g = np.zeros((512, 512), np.uint8)
+    g[100:103, 100:110] = 1
+    p = jnp.asarray(bitpack.pack(jnp.asarray(g)))
+    s = SparseEngineState(p, CONWAY, topology=Topology.DEAD)
+    assert s._adaptive and s.capacity <= 64
+    # ...then a capacity-busting soup forces doubling, never a wrong result
+    soup = np.random.default_rng(3).integers(0, 2, (512, 512), np.uint8)
+    p2 = jnp.asarray(bitpack.pack(jnp.asarray(soup)))
+    s2 = SparseEngineState(p2, CONWAY, topology=Topology.DEAD)
+    s2._set_capacity(32)  # simulate a badly-undersized start
+    s2.step(24)
+    want = bitpack.unpack(multi_step_packed(
+        p2, 24, rule=CONWAY, topology=Topology.DEAD))
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(s2.packed)), np.asarray(want))
+    assert s2.capacity > 32  # escalated rather than dense-stepping forever
+
+
+def test_explicit_capacity_stays_fixed():
+    soup = np.random.default_rng(4).integers(0, 2, (256, 256), np.uint8)
+    p = jnp.asarray(bitpack.pack(jnp.asarray(soup)))
+    s = SparseEngineState(p, CONWAY, capacity=16, topology=Topology.DEAD)
+    s.step(12)
+    assert s.capacity == 16  # dense fallback, no silent escalation
+    want = bitpack.unpack(multi_step_packed(
+        p, 12, rule=CONWAY, topology=Topology.DEAD))
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(s.packed)), np.asarray(want))
