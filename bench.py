@@ -74,9 +74,23 @@ def _config_key(args) -> str:
 def _load_persisted(key: str) -> dict | None:
     try:
         with open(PERSIST_PATH) as f:
-            return json.load(f).get(key)
+            store = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+    hit = store.get(key)
+    if hit is None:
+        # requested and resolved names are interchangeable evidence for the
+        # same config: an auto run may have persisted under its resolved
+        # backend and vice versa — prefer any of them over a CPU fallback
+        rest = key.split(":", 1)[1]
+        if key.startswith("auto:"):
+            alts = ["pallas:", "packed:", "dense:"]
+        else:
+            alts = ["auto:"]
+        cands = [c for c in (store.get(a + rest) for a in alts) if c is not None]
+        if cands:
+            hit = max(cands, key=lambda c: c["value"])
+    return hit
 
 
 def _persist_if_best(key: str, result: dict) -> None:

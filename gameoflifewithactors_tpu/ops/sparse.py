@@ -283,12 +283,16 @@ class SparseEngineState:
         self.shape = (H, Wp)
         self.padded = jnp.pad(packed, 1)
         self.active = initial_activity(self.padded, tile_rows, tile_words)
+        nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+        self._cap_ceiling = min(_MAX_ADAPTIVE_CAPACITY,
+                                1 << (nty * ntx - 1).bit_length())
         if self._adaptive:
             # 9x the seeded tiles covers the first dilations; pow2 keeps the
-            # lru-cached compile set small across escalations
+            # lru-cached compile set small across escalations; never batch
+            # more windows than tiles exist (dense seeds would otherwise
+            # pay full compute on fill slots forever)
             want = max(32, 9 * int(jnp.sum(self.active)))
-            capacity = 1 << (want - 1).bit_length()
-            capacity = min(capacity, _MAX_ADAPTIVE_CAPACITY)
+            capacity = min(1 << (want - 1).bit_length(), self._cap_ceiling)
         self._set_capacity(capacity)
 
     def _set_capacity(self, capacity: int) -> None:
@@ -320,9 +324,15 @@ class SparseEngineState:
                 self.padded, self.active, remaining)
             remaining -= int(done)
             if remaining > 0:
-                if self._adaptive and self.capacity < _MAX_ADAPTIVE_CAPACITY:
-                    self._set_capacity(min(self.capacity * 2,
-                                           _MAX_ADAPTIVE_CAPACITY))
+                if self._adaptive and self.capacity < self._cap_ceiling:
+                    # one cheap map reduction tells us the needed capacity:
+                    # jump straight there (one recompile) instead of
+                    # doubling through several zero-progress dispatches
+                    need = int(jnp.sum(_dilate(
+                        self.active, self.topology is Topology.TORUS)))
+                    want = max(2 * self.capacity, need)
+                    self._set_capacity(
+                        min(1 << (want - 1).bit_length(), self._cap_ceiling))
                     continue
                 self.padded, self.active = self._dense_once(self.padded)
                 remaining -= 1

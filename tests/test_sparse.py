@@ -339,6 +339,12 @@ def test_adaptive_capacity_starts_small_and_escalates():
     np.testing.assert_array_equal(
         np.asarray(bitpack.unpack(s2.packed)), np.asarray(want))
     assert s2.capacity > 32  # escalated rather than dense-stepping forever
+    # and never beyond the number of tiles that exist (64 here)
+    assert s2.capacity <= 64
+    # dense-ish seeds clamp at construction too, instead of batching
+    # hundreds of fill windows forever
+    s3 = SparseEngineState(p2, CONWAY, topology=Topology.DEAD)
+    assert s3.capacity <= 64
 
 
 def test_explicit_capacity_stays_fixed():
