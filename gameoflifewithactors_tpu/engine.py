@@ -36,6 +36,21 @@ from .parallel import sharded
 BACKENDS = ("packed", "dense", "pallas", "sparse")
 
 
+def _chunked(bulk, pergen, g: int):
+    """(state, n) runner advancing n = chunks*g + rem generations: bulk
+    chunks through a g-generations-per-call runner, the remainder through a
+    per-generation runner. Both runners donate their input, so the
+    intermediate hand-off between them is safe by construction."""
+    def _run(s, n):
+        chunks, rem = divmod(int(n), g)
+        if chunks:
+            s = bulk(s, chunks)
+        if rem:
+            s = pergen(s, rem)
+        return s
+    return _run
+
+
 class Engine:
     """Steps a Game-of-Life grid on device.
 
@@ -78,16 +93,17 @@ class Engine:
         self._generations = isinstance(self.rule, GenRule)
         self._ltl = isinstance(self.rule, LtLRule)
         if backend == "auto":
-            backend = self._resolve_auto(grid, mesh)
+            backend = self._resolve_auto(grid, mesh, topology, gens_per_exchange)
         if gens_per_exchange < 1:
             raise ValueError(
                 f"gens_per_exchange must be >= 1, got {gens_per_exchange}")
         if gens_per_exchange != 1 and not (
-                mesh is not None and backend == "packed"
+                mesh is not None and backend in ("packed", "pallas")
                 and not (self._generations or self._ltl)):
             raise ValueError(
-                "gens_per_exchange applies to the sharded packed backend "
-                "only (mesh + backend='packed'/'auto', 3x3 binary rule)")
+                "gens_per_exchange applies to the sharded packed and pallas "
+                "backends only (mesh + backend='packed'/'pallas'/'auto', "
+                "3x3 binary rule)")
         if (self._generations or self._ltl) and backend in ("pallas", "sparse"):
             raise ValueError(
                 f"backend={backend!r} is 3x3-binary-only; "
@@ -126,11 +142,6 @@ class Engine:
         self._sparse = None
         self._flags = None
         if mesh is not None:
-            if backend == "pallas":
-                raise ValueError(
-                    "backend='pallas' is single-device; use backend='packed' "
-                    "with a mesh (the sharded SWAR path)"
-                )
             # validate in *cell* units before packing, so the error names the
             # user's grid shape, not the packed word shape
             nx = mesh.shape[mesh_lib.ROW_AXIS]
@@ -191,6 +202,22 @@ class Engine:
                     return s
 
                 self._run = _run
+            elif backend == "pallas":
+                # row-band native kernel: exchange a depth-g halo, advance g
+                # gens in the Mosaic slab kernel, crop (parallel/sharded.py
+                # make_multi_step_pallas — TORUS, (nx, 1) meshes only; it
+                # raises with directions otherwise). n % g remainders take
+                # the per-gen SWAR runner.
+                g = (gens_per_exchange if gens_per_exchange > 1
+                     else pallas_stencil.DEFAULT_GENS_PER_CALL)
+                self.gens_per_exchange = g
+                self._run = _chunked(
+                    sharded.make_multi_step_pallas(
+                        mesh, self.rule, topology, gens_per_exchange=g,
+                        donate=True),
+                    sharded.make_multi_step_packed(
+                        mesh, self.rule, topology, donate=True),
+                    g)
             else:
                 make = (
                     sharded.make_multi_step_packed
@@ -205,17 +232,7 @@ class Engine:
                     deep = sharded.make_multi_step_packed_deep(
                         mesh, self.rule, topology,
                         gens_per_exchange=gens_per_exchange, donate=True)
-                    pergen, g = self._run, gens_per_exchange
-
-                    def _run_deep(s, n):
-                        chunks, rem = divmod(int(n), g)
-                        if chunks:
-                            s = deep(s, chunks)
-                        if rem:
-                            s = pergen(s, rem)
-                        return s
-
-                    self._run = _run_deep
+                    self._run = _chunked(deep, self._run, gens_per_exchange)
         elif backend == "sparse":
             from .ops.sparse import (
                 DEFAULT_TILE_ROWS,
@@ -291,14 +308,16 @@ class Engine:
             )
         self._state = state
 
-    def _resolve_auto(self, grid, mesh: Optional[Mesh]) -> str:
+    def _resolve_auto(self, grid, mesh: Optional[Mesh], topology: Topology,
+                      gens_per_exchange: int = 1) -> str:
         """'auto' = the fastest correct backend for this rule/platform/shape:
-        the temporal-blocked native Pallas kernel (measured ~2.8x the XLA
-        SWAR rate on a v5e) for single-device 3x3 binary rules at shapes it
-        supports; the packed SWAR path everywhere else. Off 'packed',
-        Generations rules take the bit-plane stack when the width packs
-        (% 32), the byte path otherwise; LtL picks bit-sliced packed on
-        TPU and the byte path elsewhere (see the platform note below)."""
+        the temporal-blocked native Pallas kernel (measured 1.78e12
+        cell-updates/s on a v5e, ~10x the XLA SWAR rate) for 3x3 binary
+        rules at shapes it supports — single-device, and TORUS (nx, 1)
+        row-band meshes on TPU; the packed SWAR path everywhere else. Off
+        'packed', Generations rules take the bit-plane stack when the width
+        packs (% 32), the byte path otherwise; LtL picks bit-sliced packed
+        on TPU and the byte path elsewhere (see the platform note below)."""
         if self._ltl:
             # the bit-sliced LtL path wins on the TPU VPU but measured
             # ~2.4x slower than the byte path under XLA's CPU lowering;
@@ -309,12 +328,32 @@ class Engine:
                     and shape[1] % bitpack.WORD == 0):
                 return "packed"
             return "dense"
-        if mesh is not None or self._generations:
+        if self._generations:
             return "packed"
+        on_tpu = not pallas_stencil.default_interpret()
         shape = np.shape(grid)
         if len(shape) != 2 or shape[1] % bitpack.WORD:
             return "packed"  # shape errors surface in the main path
-        on_tpu = not pallas_stencil.default_interpret()
+        if mesh is not None:
+            # native row-band path: TORUS (nx, 1) meshes whose bands keep
+            # the kernel's alignment (width % 4096, extended band height
+            # divisible into 8-row blocks: th % 8, exchange depth % 8).
+            # An explicit gens_per_exchange the slab kernel cannot honor
+            # (not a multiple of 8, or deeper than the band) must keep
+            # resolving to the packed deep runner, as it did before the
+            # band path existed — auto never picks a crashing backend.
+            nx = mesh.shape[mesh_lib.ROW_AXIS]
+            ny = mesh.shape[mesh_lib.COL_AXIS]
+            th = shape[0] // nx if shape[0] % nx == 0 else 0
+            g = (gens_per_exchange if gens_per_exchange > 1
+                 else pallas_stencil.DEFAULT_GENS_PER_CALL)
+            if (on_tpu and ny == 1 and topology is Topology.TORUS
+                    and th > 0
+                    and pallas_stencil.band_supported(th, g, native=True)
+                    and pallas_stencil.supported(
+                        (shape[0], shape[1] // bitpack.WORD), on_tpu=True)):
+                return "pallas"
+            return "packed"
         if on_tpu and pallas_stencil.supported(
                 (shape[0], shape[1] // bitpack.WORD), on_tpu=True):
             return "pallas"

@@ -40,15 +40,20 @@ from ..models.rules import Rule
 from .stencil import Topology
 from .packed import multi_step_packed, step_packed_slab as step_rows
 
-DEFAULT_BLOCK_ROWS = 256
+# Autotuned on v5e-1 (results/tpu_worklist.json pallas_autotune, 16384^2):
+# (512, 8) measured 1.78e12 cell-updates/s, ahead of 256/1024-row blocks
+# and of g=16 at every block height (the kernel is compute-bound past g=8).
+DEFAULT_BLOCK_ROWS = 512
 DEFAULT_GENS_PER_CALL = 8
 
 
-def _zero_exterior(slab, block_idx, n_blocks, halo, topology):
-    """For DEAD topology, force rows outside the global grid back to dead
-    (they must not evolve with the slab). ``halo`` = rows of exterior still
-    present on each side at this point in the in-block generation loop."""
-    if topology is not Topology.DEAD or halo <= 0:
+def _zero_edge_rows(slab, block_idx, n_blocks, halo):
+    """Zero the outer ``halo`` rows of the first/last block's slab. Callers
+    decide *when*: full-grid DEAD re-zeroes the shrinking exterior every
+    generation (permanently-dead cells must not evolve); slab mode zeroes
+    the out-of-range DMA payload once (dead closure beyond the exchanged
+    halo, corruption absorbed by the crop)."""
+    if halo <= 0:
         return slab
     L = slab.shape[0]
     rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 0)
@@ -57,7 +62,28 @@ def _zero_exterior(slab, block_idx, n_blocks, halo, topology):
     return jnp.where(top_ext | bot_ext, jnp.uint32(0), slab)
 
 
-def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int, g: int):
+def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
+                 g: int, slab_mode: bool = False):
+    """The temporal-blocked kernel body, in one of two closure modes.
+
+    Full-grid mode (``slab_mode=False``): the H rows are the whole universe;
+    vertical wrap rides the wrapped DMAs, DEAD re-zeroes the exterior rows
+    of boundary blocks before *every* in-slab generation (exterior cells are
+    permanently dead — they must not evolve with the slab).
+
+    Slab mode (``slab_mode=True``): the H rows are a halo-extended row band
+    (``th + 2g``; outer g rows = *exchanged neighbor data*, parallel/
+    sharded.py make_multi_step_pallas) spanning the full grid width.
+    Vertical out-of-range segments (above row 0 / below row H) are unknown
+    beyond the exchanged depth → the wrapped DMA's payload is zeroed ONCE
+    before the generation loop (dead closure; the resulting edge corruption
+    creeps 1 row/gen and ends inside the g cropped halo rows, so the band
+    interior stays exact). No per-generation re-zero: every in-slab row is
+    real band or halo data and must evolve freely. ``topology`` is the
+    *global horizontal* closure only (TORUS wraps in-VMEM across the full
+    width, globally correct for row bands; vertical global wrap rides the
+    halo exchange outside).
+    """
     n_blocks = H // bh
     L = bh + 2 * g
 
@@ -67,7 +93,9 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int, g: in
         # 3 contiguous segments (wrap segments are contiguous since g <= bh).
         # Mosaic must prove the dynamic row offsets divisible by the (8, 128)
         # sublane tiling; the jnp.where obscures that, so assert it with
-        # multiple_of (sound: H, bh, g are all multiples of 8 natively).
+        # multiple_of (sound: H, bh, g are all multiples of 8 natively). In
+        # slab mode the wrap formula is only an arbitrary aligned in-range
+        # window — its payload is zeroed below.
         top = pl.multiple_of(jnp.where(i == 0, H - g, base - g), 8)
         bot = pl.multiple_of(jnp.where(i == n_blocks - 1, 0, base + bh), 8)
         d_top = pltpu.make_async_copy(
@@ -84,12 +112,91 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int, g: in
         d_bot.wait()
 
         slab = slab_ref[:]
-        for k in range(g):
-            slab = _zero_exterior(slab, i, n_blocks, g - k, topology)
-            slab = step_rows(slab, rule, topology)
+        if slab_mode:
+            for k in range(g):
+                if k == 0:
+                    slab = _zero_edge_rows(slab, i, n_blocks, g)
+                slab = step_rows(slab, rule, topology)
+        else:
+            for k in range(g):
+                if topology is Topology.DEAD:
+                    slab = _zero_edge_rows(slab, i, n_blocks, g - k)
+                slab = step_rows(slab, rule, topology)
         out_ref[:] = slab
 
     return kernel, n_blocks, L
+
+
+@lru_cache(maxsize=64)
+def _build_slab_runner(rule: Rule, topology: Topology, ext_shape, bh: int,
+                       g: int, interpret: bool):
+    He, Wp = ext_shape
+    kernel, n_blocks, L = _make_kernel(rule, topology, He, Wp, bh, g,
+                                       slab_mode=True)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((He, Wp), jnp.uint32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((L, Wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )
+
+
+def make_pallas_slab_step(
+    rule: Rule,
+    topology: Topology,
+    ext_shape,
+    *,
+    gens: int,
+    block_rows: Optional[int] = None,
+    interpret: bool = False,
+):
+    """``ext (He, Wp) -> (He, Wp)`` advancing ``gens`` generations of a
+    halo-extended full-width row band (He = band rows + 2*gens); the caller
+    crops ``out[gens:-gens]`` for the exact band interior. ``topology`` is
+    the global horizontal closure (see _make_kernel slab mode). Note: a caller
+    wrapping this in shard_map must pass ``check_vma=False`` — the vma
+    checker cannot type the kernel's scratch-DMA primitives."""
+    He, Wp = ext_shape
+    g = int(gens)
+    bh = block_rows or _pick_bh(He, native=not interpret, at_least=g)
+    if He % bh:
+        raise ValueError(f"extended height {He} not divisible by block rows {bh}")
+    if g > bh:
+        # the 3-segment DMA scheme needs the g rows above/below a block to
+        # be contiguous in the previous/next block: g <= bh. Violations are
+        # NOT caught downstream — interior blocks assemble wrong neighbor
+        # rows (clamped offsets in interpret mode, out-of-range DMAs native)
+        raise ValueError(
+            f"slab kernel needs gens ({g}) <= block_rows ({bh}); pick a "
+            f"larger block_rows or a shallower exchange depth")
+    if not interpret and (bh % 8 or g % 8):
+        raise ValueError(
+            f"native TPU slab kernel needs block_rows ({bh}) and gens ({g}) "
+            f"to be multiples of 8 (sublane tiling)")
+    return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret)
+
+
+def band_supported(band_rows: int, g: int, *, native: bool) -> bool:
+    """Whether the slab kernel can run a ``band_rows``-row band with a
+    depth-``g`` exchange: alignment (band % 8, g % 8 native), exchange depth
+    within the band, and a block decomposition of the extended height with
+    blocks >= g rows must exist. Engine's auto resolution gates on this so
+    'auto' never selects a configuration the kernel would reject."""
+    if g < 1 or g > band_rows:
+        return False
+    if native and (band_rows % 8 or g % 8):
+        return False
+    try:
+        bh = _pick_bh(band_rows + 2 * g, native=native, at_least=g)
+    except ValueError:
+        return False
+    return g <= bh
 
 
 def supported(shape, *, on_tpu: bool) -> bool:
@@ -109,18 +216,22 @@ def default_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _pick_bh(H: int, native: bool = False) -> int:
-    """Largest block height <= DEFAULT_BLOCK_ROWS dividing H (8-aligned
-    when targeting real Mosaic, see the multiple_of hints in the kernel)."""
-    bh = min(DEFAULT_BLOCK_ROWS, H)
+def _pick_bh(H: int, native: bool = False, at_least: int = 1) -> int:
+    """Largest block height <= max(DEFAULT_BLOCK_ROWS, at_least) dividing H
+    (8-aligned when targeting real Mosaic, see the multiple_of hints in the
+    kernel), and >= ``at_least`` (the slab path's DMA scheme needs blocks at
+    least as tall as the exchange depth)."""
+    bh = min(max(DEFAULT_BLOCK_ROWS, at_least), H)
     step = 1
     if native:
         bh -= bh % 8
         step = 8
-    while bh > 0 and H % bh:
+    while bh >= max(at_least, 1) and H % bh:
         bh -= step
-    if bh <= 0:
-        raise ValueError(f"no usable block height for grid height {H}")
+    if bh < max(at_least, 1):
+        raise ValueError(
+            f"no usable block height for grid height {H}"
+            + (f" with blocks >= {at_least} rows" if at_least > 1 else ""))
     return bh
 
 

@@ -176,7 +176,7 @@ def make_multi_step_packed_deep(
         # DEAD topology: cells beyond the global grid are *permanently*
         # dead, but the slab advance would happily evolve them (a birth
         # just outside the edge feeds back from the 2nd generation on —
-        # same failure mode ops/pallas_stencil.py's _zero_exterior guards).
+        # same failure mode ops/pallas_stencil.py's _zero_edge_rows guards).
         # Re-zero the remaining exterior rows/halo-words of global-edge
         # tiles before every in-slab generation.
         L = slab.shape[0]
@@ -204,6 +204,81 @@ def make_multi_step_packed_deep(
         return ext[:, 1:-1]  # drop the (partly corrupted) halo words
 
     @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
+    def _run(tile, chunks):
+        return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step_pallas(
+    mesh: Mesh,
+    rule: Rule,
+    topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+    donate: bool = False,
+) -> Callable:
+    """Sharded stepping through the native Mosaic kernel: the flagship
+    single-chip path (ops/pallas_stencil.py, 1.78e12 cell-updates/s on
+    v5e-1) composed with multi-chip scaling.
+
+    Decomposition is row *bands* on an (nx, 1) mesh — the band spans the
+    full grid width, which is what lets the kernel keep its two structural
+    assumptions: the lane dimension stays a multiple of 128 words (a 2D
+    tile's ``w/ny + 2`` halo-extended width almost never is), and the
+    in-VMEM horizontal TORUS roll remains *globally* correct. Per chunk,
+    each device ppermutes a depth-``g`` row halo (4 sends, two-phase not
+    needed — one axis), then the slab kernel advances the extended band g
+    generations on-chip and the g corrupted halo rows are cropped. Unlike
+    make_multi_step_packed_deep, g is NOT capped at 32: there is no
+    horizontal halo word to creep through, so g is bounded only by the band
+    height (and by redundant-compute appetite, 2g rows/band/chunk).
+
+    TORUS only: a DEAD *vertical* closure needs the permanently-dead
+    exterior re-zeroed inside every in-slab generation for global-edge
+    bands, which the slab kernel (fixed per-device program) cannot decide
+    per device; use make_multi_step_packed_deep for DEAD topologies.
+
+    Returns jitted ``(grid, chunks) -> grid`` advancing ``chunks * g``
+    generations (``chunks`` traced, g static), grid sharded P('x', None).
+    """
+    from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
+
+    if topology is not Topology.TORUS:
+        raise ValueError(
+            "make_multi_step_pallas supports TORUS only (a DEAD vertical "
+            "closure needs per-device exterior re-zeroing inside the "
+            "kernel); use make_multi_step_packed_deep for DEAD")
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if ny != 1:
+        raise ValueError(
+            f"make_multi_step_pallas needs an (nx, 1) row-band mesh so each "
+            f"band spans the full width (got ny={ny}); reshape the mesh or "
+            f"use make_multi_step_packed")
+    g = int(gens_per_exchange)
+    if interpret is None:
+        interpret = default_interpret()
+
+    band_spec = P(ROW_AXIS, None)
+
+    def chunk(tile):
+        if g > tile.shape[0]:  # static shapes: caught at trace time
+            raise ValueError(
+                f"gens_per_exchange={g} exceeds the per-device band height "
+                f"{tile.shape[0]} (exchange_rows needs depth <= band rows)")
+        ext = exchange_rows(tile, nx, topology, depth=g)
+        call = make_pallas_slab_step(
+            rule, topology, ext.shape, gens=g, block_rows=block_rows,
+            interpret=interpret)
+        return call(ext)[g:-g]
+
+    # check_vma=False: jax's varying-manual-axes checker cannot type the
+    # kernel's scratch-DMA primitives (dynamic_slice over a vma-free scratch
+    # ref) and rejects the program on both the interpret and native paths;
+    # correctness is carried by the bit-identity suite instead
+    @partial(shard_map, mesh=mesh, in_specs=(band_spec, P()),
+             out_specs=band_spec, check_vma=False)
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 

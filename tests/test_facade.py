@@ -190,7 +190,9 @@ def test_engine_pallas_backend():
     e.step(8)
     np.testing.assert_array_equal(e.snapshot(), np.roll(g, (2, 2), (0, 1)))
     assert e.population() == 5
-    with pytest.raises(ValueError, match="single-device"):
+    # pallas + mesh is the row-band runner: 2D tile meshes stay rejected
+    # (tests/test_sharding.py TestShardedPallas covers the supported shapes)
+    with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
         Engine(np.zeros((16, 256), np.uint8), "conway", backend="pallas",
                mesh=mesh_lib.make_mesh((2, 4)))
 

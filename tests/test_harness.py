@@ -91,6 +91,29 @@ def test_bench_persistence_round_trip(tmp_path, monkeypatch):
     assert bench._load_persisted("sparse:65536:B3/S23")["value"] == 1.0
 
 
+def test_bench_persisted_alternate_backend_matching(tmp_path, monkeypatch):
+    """An 'auto' request may use any resolved-backend record; an explicit
+    request may use an 'auto' record ONLY when that run resolved to the
+    requested backend (the metric string names it) — a pallas number must
+    never stand in for a --backend dense measurement."""
+    import bench
+
+    monkeypatch.setattr(bench, "PERSIST_PATH", str(tmp_path / "tpu_best.json"))
+    bench._persist_if_best("auto:default:B3/S23", {
+        "metric": "cell-updates/sec/chip, 16384x16384 B3/S23 (pallas, 50% soup, tpu)",
+        "value": 1.3e12, "unit": "cell-updates/sec", "vs_baseline": 1300.0})
+
+    assert bench._load_persisted("pallas:default:B3/S23")["value"] == 1.3e12
+    assert bench._load_persisted("dense:default:B3/S23") is None
+    assert bench._load_persisted("packed:default:B3/S23") is None
+
+    bench._persist_if_best("packed:default:B3/S23", {
+        "metric": "cell-updates/sec/chip, 16384x16384 B3/S23 (packed, 50% soup, tpu)",
+        "value": 1.7e11, "unit": "cell-updates/sec", "vs_baseline": 170.0})
+    # auto prefers the best across resolved records
+    assert bench._load_persisted("auto:default:B3/S23")["value"] == 1.3e12
+
+
 def test_bench_config_key_uses_requested_size():
     import bench
 

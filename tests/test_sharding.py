@@ -254,9 +254,9 @@ class TestCommunicationAvoiding:
         want.step(19)
         got.step(19)
         np.testing.assert_array_equal(want.snapshot(), got.snapshot())
-        with pytest.raises(ValueError, match="sharded packed backend"):
+        with pytest.raises(ValueError, match="sharded packed and pallas"):
             Engine(grid, "conway", gens_per_exchange=8)  # no mesh
-        with pytest.raises(ValueError, match="sharded packed backend"):
+        with pytest.raises(ValueError, match="sharded packed and pallas"):
             Engine(grid, "brain", mesh=m, gens_per_exchange=8)  # multi-state
 
     def test_deep_mode_halo_estimate_and_validation(self):
@@ -271,3 +271,97 @@ class TestCommunicationAvoiding:
         assert 0 < deep < base
         with pytest.raises(ValueError, match=">= 1"):
             Engine(grid, "conway", mesh=m, gens_per_exchange=0)
+
+
+class TestShardedPallas:
+    """make_multi_step_pallas: row-band sharding over the Mosaic slab kernel.
+
+    Interpret mode on the 8-fake-CPU rig (the kernel itself is proven
+    native-vs-XLA bit-identical on chip in results/tpu_worklist.json
+    pallas_identity); these tests pin the *composition* — halo depth, slab
+    zero-fill, crop — against the single-device packed path.
+    """
+
+    @pytest.mark.parametrize("mesh_shape,grid_h,g", [
+        ((8, 1), 64, 1),
+        ((8, 1), 64, 3),
+        ((8, 1), 64, 8),
+        ((4, 1), 192, 40),  # g > 32: no halo-word creep cap on row bands
+    ])
+    def test_bit_identity_vs_single_device(self, mesh_shape, grid_h, g):
+        m = _mesh(mesh_shape)
+        rng = np.random.default_rng(29)
+        grid = rng.integers(0, 2, size=(grid_h, 256), dtype=np.uint8)
+        p_single = bitpack.pack(jnp.asarray(grid))
+        chunks = 3
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            p_single, chunks * g, rule=CONWAY, topology=Topology.TORUS)))
+
+        p = mesh_lib.device_put_sharded_grid(p_single, m)
+        run = sharded.make_multi_step_pallas(
+            m, CONWAY, gens_per_exchange=g, interpret=True)
+        got = np.asarray(bitpack.unpack(run(p, chunks)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_glider_wraps_vertical_band_boundaries(self):
+        """A glider flying SE through band boundaries AND the global torus
+        wrap: exercises the exchanged halo rows and the slab crop."""
+        m = _mesh((8, 1))
+        grid = np.asarray(seeds.seeded((64, 256), "glider", 58, 60))
+        p_single = bitpack.pack(jnp.asarray(grid))
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            p_single, 48, rule=CONWAY, topology=Topology.TORUS)))
+        run = sharded.make_multi_step_pallas(
+            m, CONWAY, gens_per_exchange=8, interpret=True)
+        got = np.asarray(bitpack.unpack(
+            run(mesh_lib.device_put_sharded_grid(p_single, m), 6)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_rejects_non_band_mesh_dead_topology_and_deep_g(self):
+        with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
+            sharded.make_multi_step_pallas(_mesh((2, 4)), CONWAY)
+        with pytest.raises(ValueError, match="TORUS only"):
+            sharded.make_multi_step_pallas(
+                _mesh((8, 1)), CONWAY, topology=Topology.DEAD)
+        m = _mesh((8, 1))
+        run = sharded.make_multi_step_pallas(
+            m, CONWAY, gens_per_exchange=16, interpret=True)
+        p = mesh_lib.device_put_sharded_grid(
+            bitpack.pack(jnp.zeros((64, 256), jnp.uint8)), m)  # band h = 8
+        with pytest.raises(ValueError, match="band height"):
+            run(p, 1)
+
+    def test_engine_facade_pallas_mesh(self):
+        from gameoflifewithactors_tpu import Engine
+
+        m = _mesh((8, 1))
+        grid = np.asarray(seeds.seeded((64, 256), "glider", 10, 10))
+        want = Engine(grid, "conway", mesh=m)          # sharded SWAR
+        got = Engine(grid, "conway", mesh=m, backend="pallas",
+                     gens_per_exchange=8)
+        want.step(19)
+        got.step(19)                                   # 2 chunks + 3 remainder
+        np.testing.assert_array_equal(want.snapshot(), got.snapshot())
+        # ny=1: depth-g exchange moves the same bytes as g 1-deep trips
+        # (the win is 1/g the collective count); estimate must not grow
+        assert got.halo_bytes_per_gen() <= want.halo_bytes_per_gen()
+        with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
+            Engine(grid, "conway", mesh=_mesh((2, 4)), backend="pallas")
+
+    def test_rejects_exchange_deeper_than_blocks(self):
+        """g > block_rows breaks the 3-segment DMA contiguity contract and
+        must be rejected, not silently mis-assembled (review finding)."""
+        from gameoflifewithactors_tpu.ops.pallas_stencil import (
+            band_supported,
+            make_pallas_slab_step,
+        )
+
+        with pytest.raises(ValueError, match="<= block_rows"):
+            make_pallas_slab_step(CONWAY, Topology.TORUS, (96, 8), gens=24,
+                                  block_rows=16, interpret=True)
+        # the auto gate agrees with the kernel's own validation
+        assert not band_supported(16, 24, native=True)
+        assert band_supported(2048, 8, native=True)
+        assert not band_supported(2048, 12, native=True)   # g % 8
+        assert not band_supported(2044, 8, native=True)    # band % 8
+        assert band_supported(48, 24, native=False)        # interpret: ok
