@@ -193,10 +193,12 @@ def band_supported(band_rows: int, g: int, *, native: bool) -> bool:
     if native and (band_rows % 8 or g % 8):
         return False
     try:
-        bh = _pick_bh(band_rows + 2 * g, native=native, at_least=g)
+        # raises when no divisor of the extended height is >= g (the DMA
+        # contiguity floor) — a returned bh always satisfies g <= bh
+        _pick_bh(band_rows + 2 * g, native=native, at_least=g)
     except ValueError:
         return False
-    return g <= bh
+    return True
 
 
 def supported(shape, *, on_tpu: bool) -> bool:

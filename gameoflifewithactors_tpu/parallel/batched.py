@@ -67,3 +67,61 @@ def make_multi_step_packed_batched(
     # donation opt-in: see ops/_jit.py for why consuming the caller's batch
     # by default is a TPU-only footgun
     return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step_pallas_batched(
+    mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    block_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    donate: bool = False,
+) -> Callable:
+    """The DP × native-kernel corner of the parallelism matrix: a (nb, nx,
+    1) mesh where every device advances its universes' full-width row bands
+    through the Mosaic slab kernel (parallel/sharded.py
+    make_multi_step_pallas has the band/TORUS rationale; the same
+    restrictions apply). One depth-g ppermute per side per chunk carries
+    ALL local universes (halo.exchange_rows_stack); each universe then runs
+    its own kernel call — a static loop, not vmap, because vmapping a
+    manual-DMA pallas_call is unsupported territory.
+
+    Returns jitted ``(grids, chunks) -> grids`` over a (B, H, W/32) packed
+    batch advancing ``chunks * g`` generations.
+    """
+    from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
+    from .halo import exchange_rows_stack
+
+    if topology is not Topology.TORUS:
+        raise ValueError(
+            "make_multi_step_pallas_batched supports TORUS only (see "
+            "make_multi_step_pallas); use make_multi_step_packed_batched")
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if ny != 1:
+        raise ValueError(
+            f"make_multi_step_pallas_batched needs an (nb, nx, 1) row-band "
+            f"mesh (got ny={ny}); use make_multi_step_packed_batched")
+    g = int(gens_per_exchange)
+    if interpret is None:
+        interpret = default_interpret()
+    spec = P(BATCH_AXIS, ROW_AXIS, None)
+
+    def chunk(tiles):
+        if g > tiles.shape[1]:  # static shapes: caught at trace time
+            raise ValueError(
+                f"gens_per_exchange={g} exceeds the per-device band height "
+                f"{tiles.shape[1]}")
+        ext = exchange_rows_stack(tiles, nx, topology, depth=g)
+        call = make_pallas_slab_step(
+            rule, topology, ext.shape[1:], gens=g, block_rows=block_rows,
+            interpret=interpret)
+        out = [call(ext[i])[g:-g] for i in range(ext.shape[0])]
+        return jax.numpy.stack(out)
+
+    # check_vma=False: same scratch-DMA typing limitation as
+    # sharded.make_multi_step_pallas
+    @partial(shard_map, mesh=mesh, in_specs=(spec, P()), out_specs=spec,
+             check_vma=False)
+    def _run(tiles, n):
+        return jax.lax.fori_loop(0, n, lambda _, t: chunk(t), tiles)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())

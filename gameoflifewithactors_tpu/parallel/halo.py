@@ -65,6 +65,18 @@ def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_A
     return jnp.concatenate([west, ext, east], axis=1)
 
 
+def exchange_rows_stack(stack: jax.Array, nx: int, topology: Topology,
+                        depth: int = 1) -> jax.Array:
+    """(b, h, w) stack -> (b, h+2d, w): the row half of
+    :func:`exchange_halo_stack` — one ppermute per side carries all b
+    members. Serves the batched row-band runner, whose full-width bands
+    need no column phase."""
+    wrap = topology is Topology.TORUS
+    north = lax.ppermute(stack[:, -depth:, :], ROW_AXIS, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(stack[:, :depth, :], ROW_AXIS, _shift_perm(nx, -1, wrap))
+    return jnp.concatenate([north, stack, south], axis=1)
+
+
 def exchange_halo_stack(stack: jax.Array, nx: int, ny: int, topology: Topology,
                         depth: int = 1) -> jax.Array:
     """(b, h, w) plane stack -> (b, h+2d, w+2d): the same two-phase trip as
@@ -72,9 +84,7 @@ def exchange_halo_stack(stack: jax.Array, nx: int, ny: int, topology: Topology,
     (payload (b, d, w)) instead of b separate sends — 4 collectives per
     generation for the bit-plane Generations layout regardless of b."""
     wrap = topology is Topology.TORUS
-    north = lax.ppermute(stack[:, -depth:, :], ROW_AXIS, _shift_perm(nx, +1, wrap))
-    south = lax.ppermute(stack[:, :depth, :], ROW_AXIS, _shift_perm(nx, -1, wrap))
-    ext = jnp.concatenate([north, stack, south], axis=1)
+    ext = exchange_rows_stack(stack, nx, topology, depth=depth)
     west = lax.ppermute(ext[:, :, -depth:], COL_AXIS, _shift_perm(ny, +1, wrap))
     east = lax.ppermute(ext[:, :, :depth], COL_AXIS, _shift_perm(ny, -1, wrap))
     return jnp.concatenate([west, ext, east], axis=2)

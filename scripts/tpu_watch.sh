@@ -1,0 +1,20 @@
+#!/bin/bash
+# Healthy-window watcher: probe every 5 min; on the first healthy probe,
+# re-capture the round's TPU evidence (worklist items + bench configs),
+# then exit. Safe to re-run; all artifacts merge/persist best-wins.
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 90 python scripts/tpu_probe.py 2>/dev/null | grep -q '^healthy'; then
+    echo "=== healthy at $(date -u +%H:%M:%S), capturing ==="
+    timeout 3000 python scripts/tpu_worklist.py --force \
+      --items pallas_identity,pallas_band,bench_packed,ltl_bosco,generations_brain,config5_sparse
+    timeout 600 python bench.py --no-probe
+    timeout 600 python bench.py --no-probe --size 1024
+    timeout 600 python bench.py --no-probe --size 8192
+    echo "=== capture done at $(date -u +%H:%M:%S) ==="
+    exit 0
+  fi
+  echo "probe $i: not healthy at $(date -u +%H:%M:%S)"
+  sleep 300
+done
+echo "gave up after 60 probes"

@@ -62,6 +62,20 @@ def main() -> None:
         mesh, CONWAY, Topology.TORUS, gens_per_exchange=g)
     got_deep = multihost.gather_global(deep(p, gens // g))  # p still live
     np.testing.assert_array_equal(got_deep, want)
+
+    # row-band runner driving the Pallas slab kernel (interpret mode on
+    # this CPU rig; the kernel is native-proven on-chip — results/
+    # tpu_worklist.json pallas_band): every process owns 2 full-width
+    # bands, the depth-g halo ppermutes cross REAL process boundaries
+    bmesh = multihost.global_mesh((2 * n_procs, 1))
+    bgrid = seeds.seeded((8 * 2 * n_procs, 64), "glider", 1, 1)
+    bpacked = bitpack.pack_np(bgrid)
+    bp = multihost.put_global_grid(bpacked, bmesh)
+    brun = sharded.make_multi_step_pallas(bmesh, CONWAY, gens_per_exchange=8)
+    got_band = multihost.gather_global(brun(bp, 5))
+    want_band = np.asarray(multi_step_packed(
+        jnp.asarray(bpacked), 40, rule=CONWAY, topology=Topology.TORUS))
+    np.testing.assert_array_equal(got_band, want_band)
     print(f"MULTIHOST-OK proc={pid}/{n_procs} devices={len(jax.devices())}",
           flush=True)
 
