@@ -2,12 +2,15 @@
 
 Where the reference pays ~9 mailbox messages per cell per generation
 (SURVEY.md §4b), this path pays roughly one bitwise VPU op per *word* per
-adder stage: the 8 neighbor indicator planes are summed with a carry-save
-adder network into 4 bit-planes of the neighbor count, and the B/S rule is
-evaluated as a boolean function of those planes. Everything is uint32
-bitwise ops on static shapes — XLA fuses the whole generation into a single
-elementwise pass over ~9 shifted views of the packed grid, which is
-memory-bound at ~1 bit/cell of traffic.
+adder stage: each row's horizontal 2-bit sums (``T = w+c+e``, ``S = w+e``)
+are computed once and the neighbor count assembled as ``T_north + S +
+T_south`` — three 2-bit adds instead of an 8-plane carry-save network,
+~25% fewer ops, because every T plane feeds BOTH vertical neighbors (reuse
+a flat plane list cannot express). The B/S rule is then a boolean function
+of the 4 count bit-planes. Everything is uint32 bitwise ops on static
+shapes — XLA fuses the whole generation into a single elementwise pass,
+memory-bound at ~1 bit/cell of traffic (the Pallas kernel lifts even
+that via temporal blocking, making these op counts the bound that matters).
 
 Two entry points:
 
@@ -123,7 +126,12 @@ def horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, j
 
 
 def neighbor_planes(p: jax.Array, topology: Topology) -> List[jax.Array]:
-    """The 8 Moore-neighbor indicator planes of a packed grid."""
+    """The 8 Moore-neighbor indicator planes of a packed grid.
+
+    Kept as a reference formulation (tests cross-check the row-sum path
+    against it); the steppers below use :func:`_row_sum_bits`, which
+    reaches the same count planes with ~25% fewer VPU ops.
+    """
     planes: List[jax.Array] = []
     for dv, slab in zip((-1, 0, 1), _row_triplet(p, topology)):
         w, c, e = horizontal_planes(slab, topology)
@@ -131,10 +139,48 @@ def neighbor_planes(p: jax.Array, topology: Topology) -> List[jax.Array]:
     return planes
 
 
+def _row_sum_bits(w, c, e, north_south, center_rows):
+    """Neighbor-count bit-planes via shared per-row horizontal sums.
+
+    Instead of feeding 8 shifted planes to a CSA network (each row's
+    horizontal triple re-derived for all 3 vertical offsets), compute per
+    row ONCE the 2-bit sums ``T = w + c + e`` (0..3, feeds the rows above
+    and below) and ``S = w + e`` (0..2, the center row's own contribution),
+    then add three 2-bit numbers: count = T_north + S + T_south. The 3x
+    reuse of T is what the naive plane list cannot express and XLA's CSE
+    does not recover across differently-shifted slices.
+
+    ``north_south(plane) -> (north_view, south_view)`` supplies the
+    vertical alignment (wrap/zero roll for whole grids, row slices for
+    slabs); ``center_rows(plane)`` selects the center-row window of a
+    full-height plane (identity for whole grids).
+    """
+    t0, t1 = _csa(w, c, e)               # T = w + c + e in 2 bits
+    s0, s1 = w ^ e, w & e                # S = w + e
+    tn0, ts0 = north_south(t0)
+    tn1, ts1 = north_south(t1)
+    s0, s1 = center_rows(s0), center_rows(s1)
+    # T_n + S + T_s: three 2-bit numbers -> 4 LSB-first count planes (<= 8)
+    r0, k1 = _csa(tn0, s0, ts0)
+    s, k2 = _csa(tn1, s1, ts1)
+    r1 = s ^ k1
+    k2b = s & k1
+    return [r0, r1, k2 ^ k2b, k2 & k2b]
+
+
 @optionally_donated("p")
 def step_packed(p: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS) -> jax.Array:
     """One generation on a (H, W/32) uint32 packed grid."""
-    bits = bit_sliced_sum(neighbor_planes(p, topology))
+    return _step_whole(p, rule, topology)
+
+
+def _step_whole(p: jax.Array, rule: Rule, topology: Topology) -> jax.Array:
+    def north_south(plane):
+        n, _, s = _row_triplet(plane, topology)
+        return n, s
+
+    w, c, e = horizontal_planes(p, topology)
+    bits = _row_sum_bits(w, c, e, north_south, lambda plane: plane)
     return apply_rule_planes(p, bits, rule)
 
 
@@ -148,7 +194,7 @@ def multi_step_packed(
 ) -> jax.Array:
     """``n`` generations in one jitted fori_loop over the fused SWAR step."""
     def body(_, s):
-        return apply_rule_planes(s, bit_sliced_sum(neighbor_planes(s, topology)), rule)
+        return _step_whole(s, rule, topology)
     return jax.lax.fori_loop(0, n, body, p)
 
 
@@ -162,17 +208,14 @@ def step_packed_slab(slab: jax.Array, rule: Rule, topology: Topology) -> jax.Arr
     whose 32-cell halo words absorb the resulting edge corruption).
     """
     h = slab.shape[0] - 2
-    planes = []
-    alive = None
-    for dv in (0, 1, 2):
-        s = jax.lax.slice_in_dim(slab, dv, dv + h, axis=0)
-        w, c, e = horizontal_planes(s, topology)
-        if dv == 1:
-            alive = c
-            planes.extend([w, e])
-        else:
-            planes.extend([w, c, e])
-    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)
+    w, c, e = horizontal_planes(slab, topology)
+    bits = _row_sum_bits(
+        w, c, e,
+        lambda plane: (jax.lax.slice_in_dim(plane, 0, h, axis=0),
+                       jax.lax.slice_in_dim(plane, 2, h + 2, axis=0)),
+        lambda plane: jax.lax.slice_in_dim(plane, 1, h + 1, axis=0))
+    return apply_rule_planes(jax.lax.slice_in_dim(slab, 1, h + 1, axis=0),
+                             bits, rule)
 
 
 def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
@@ -182,6 +225,7 @@ def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
     (32 columns) left/right — only 1 bit of each halo word is consumed, but
     shipping whole words keeps ppermute payloads aligned and the plane
     extraction uniform. No wraparound: all neighbors come from real slices.
+    Reference formulation, like :func:`neighbor_planes`.
     """
     h = ext.shape[0] - 2
     planes: List[jax.Array] = []
@@ -203,5 +247,12 @@ def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
 
 def step_packed_ext(ext: jax.Array, rule: Rule) -> jax.Array:
     """One generation on a halo-extended tile; returns the (h, wp) interior."""
-    alive, planes = neighbor_planes_ext(ext)
-    return apply_rule_planes(alive, bit_sliced_sum(planes), rule)
+    h = ext.shape[0] - 2
+    mid = ext[:, 1:-1]
+    w = _shift_west(mid, ext[:, :-2])
+    e = _shift_east(mid, ext[:, 2:])
+    bits = _row_sum_bits(
+        w, mid, e,
+        lambda plane: (plane[:h], plane[2:h + 2]),
+        lambda plane: plane[1:h + 1])
+    return apply_rule_planes(mid[1:h + 1], bits, rule)

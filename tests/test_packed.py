@@ -86,3 +86,24 @@ def test_packed_word_boundary_crossing():
         p = step_packed(p, rule=CONWAY)
     np.testing.assert_array_equal(np.asarray(bitpack.unpack(p)), np.asarray(dense))
     assert np.asarray(bitpack.unpack(p)).sum() == 5
+
+
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_row_sum_bits_match_reference_planes(topology):
+    """The production row-sum count path must agree bit-for-bit with the
+    reference 8-plane CSA formulation on random grids (both are kept: the
+    reference is the spec, the row-sum form is the fast path)."""
+    from gameoflifewithactors_tpu.ops.packed import (
+        _step_whole,
+        apply_rule_planes,
+        bit_sliced_sum,
+        neighbor_planes,
+    )
+
+    rng = np.random.default_rng(41)
+    for _ in range(4):
+        p = jnp.asarray(rng.integers(0, 2 ** 32, size=(16, 8), dtype=np.uint32))
+        want = apply_rule_planes(
+            p, bit_sliced_sum(neighbor_planes(p, topology)), CONWAY)
+        got = _step_whole(p, CONWAY, topology)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
