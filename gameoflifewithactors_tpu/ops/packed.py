@@ -168,6 +168,31 @@ def _row_sum_bits(w, c, e, north_south, center_rows):
     return [r0, r1, k2 ^ k2b, k2 & k2b]
 
 
+def count_bits(p: jax.Array, topology: Topology) -> List[jax.Array]:
+    """Moore-neighbor count of a packed plane as 4 LSB-first bit-planes
+    (the row-sum fast path; also serves the Generations alive plane)."""
+    def north_south(plane):
+        n, _, s = _row_triplet(plane, topology)
+        return n, s
+
+    w, c, e = horizontal_planes(p, topology)
+    return _row_sum_bits(w, c, e, north_south, lambda plane: plane)
+
+
+def count_bits_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
+    """(interior alive plane, count bit-planes) from a halo-extended
+    (h+2, wp+2) plane — the sharded-tile face of :func:`count_bits`."""
+    h = ext.shape[0] - 2
+    mid = ext[:, 1:-1]
+    w = _shift_west(mid, ext[:, :-2])
+    e = _shift_east(mid, ext[:, 2:])
+    bits = _row_sum_bits(
+        w, mid, e,
+        lambda plane: (plane[:h], plane[2:h + 2]),
+        lambda plane: plane[1:h + 1])
+    return mid[1:h + 1], bits
+
+
 @optionally_donated("p")
 def step_packed(p: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS) -> jax.Array:
     """One generation on a (H, W/32) uint32 packed grid."""
@@ -175,13 +200,7 @@ def step_packed(p: jax.Array, *, rule: Rule, topology: Topology = Topology.TORUS
 
 
 def _step_whole(p: jax.Array, rule: Rule, topology: Topology) -> jax.Array:
-    def north_south(plane):
-        n, _, s = _row_triplet(plane, topology)
-        return n, s
-
-    w, c, e = horizontal_planes(p, topology)
-    bits = _row_sum_bits(w, c, e, north_south, lambda plane: plane)
-    return apply_rule_planes(p, bits, rule)
+    return apply_rule_planes(p, count_bits(p, topology), rule)
 
 
 @optionally_donated("p")
@@ -247,12 +266,5 @@ def neighbor_planes_ext(ext: jax.Array) -> Tuple[jax.Array, List[jax.Array]]:
 
 def step_packed_ext(ext: jax.Array, rule: Rule) -> jax.Array:
     """One generation on a halo-extended tile; returns the (h, wp) interior."""
-    h = ext.shape[0] - 2
-    mid = ext[:, 1:-1]
-    w = _shift_west(mid, ext[:, :-2])
-    e = _shift_east(mid, ext[:, 2:])
-    bits = _row_sum_bits(
-        w, mid, e,
-        lambda plane: (plane[:h], plane[2:h + 2]),
-        lambda plane: plane[1:h + 1])
-    return apply_rule_planes(mid[1:h + 1], bits, rule)
+    alive, bits = count_bits_ext(ext)
+    return apply_rule_planes(alive, bits, rule)

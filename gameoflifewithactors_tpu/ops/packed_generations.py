@@ -33,7 +33,7 @@ import numpy as np
 from ..models.generations import GenRule
 from . import bitpack
 from ._jit import optionally_donated
-from .packed import _count_eq, bit_sliced_sum, neighbor_planes
+from .packed import _count_eq, count_bits, count_bits_ext
 from .stencil import Topology
 
 
@@ -128,18 +128,15 @@ def _step_plane_list(plist, rule: GenRule, topology: Topology):
     """One generation on a tuple of b (H, W/32) planes (no stack copies —
     fori_loop carries the planes as a pytree)."""
     alive = _alive_of(plist)
-    bits = bit_sliced_sum(neighbor_planes(alive, topology))
+    bits = count_bits(alive, topology)
     return _transition(plist, alive, bits, rule)
 
 
 def step_planes_ext(ext_list, rule: GenRule):
     """One generation from b halo-extended (h+2, wp+2) planes -> interior
     (h, wp) plane tuple. Halos come from the caller (sharded ppermute)."""
-    from .packed import neighbor_planes_ext
-
     alive_ext = _alive_of(ext_list)
-    center, nplanes = neighbor_planes_ext(alive_ext)  # center = interior alive
-    bits = bit_sliced_sum(nplanes)
+    center, bits = count_bits_ext(alive_ext)  # center = interior alive
     interior = tuple(p[1:-1, 1:-1] for p in ext_list)
     return _transition(interior, center, bits, rule)
 

@@ -107,3 +107,25 @@ def test_row_sum_bits_match_reference_planes(topology):
             p, bit_sliced_sum(neighbor_planes(p, topology)), CONWAY)
         got = _step_whole(p, CONWAY, topology)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_count_bits_ext_matches_reference_planes():
+    """Same spec-vs-fast-path cross-check for the halo-extended tile form
+    (count_bits_ext vs the 8-plane neighbor_planes_ext reference)."""
+    from gameoflifewithactors_tpu.ops.packed import (
+        apply_rule_planes,
+        bit_sliced_sum,
+        count_bits_ext,
+        neighbor_planes_ext,
+        step_packed_ext,
+    )
+
+    rng = np.random.default_rng(43)
+    for _ in range(4):
+        ext = jnp.asarray(rng.integers(0, 2 ** 32, size=(18, 10), dtype=np.uint32))
+        center, planes = neighbor_planes_ext(ext)
+        want = apply_rule_planes(center, bit_sliced_sum(planes), CONWAY)
+        np.testing.assert_array_equal(
+            np.asarray(step_packed_ext(ext, CONWAY)), np.asarray(want))
+        alive, bits = count_bits_ext(ext)
+        np.testing.assert_array_equal(np.asarray(alive), np.asarray(center))
