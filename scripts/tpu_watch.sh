@@ -2,9 +2,19 @@
 # Healthy-window watcher: probe every 5 min; on the first healthy probe,
 # re-capture the round's TPU evidence (worklist items + bench configs),
 # then exit. Safe to re-run; all artifacts merge/persist best-wins.
+#
+# The probe writes to a FILE, not a pipe: `timeout` kills the probe's
+# parent but a tunnel-wedged orphan child keeps a pipe's write end open,
+# so `| grep -q` would block far past the timeout (observed: 19 min).
 cd /root/repo
+trap 'rm -f "${PROBE_OUT:-}"' EXIT
 for i in $(seq 1 60); do
-  if timeout 90 python scripts/tpu_probe.py 2>/dev/null | grep -q '^healthy'; then
+  # fresh file per iteration: a SIGTERM-surviving wedged probe from an
+  # earlier round still holds an fd and could scribble on a reused file
+  rm -f "${PROBE_OUT:-}"
+  PROBE_OUT=$(mktemp)
+  timeout 90 python scripts/tpu_probe.py > "$PROBE_OUT" 2>/dev/null
+  if grep -q '^healthy' "$PROBE_OUT"; then
     echo "=== healthy at $(date -u +%H:%M:%S), capturing ==="
     timeout 3000 python scripts/tpu_worklist.py --force \
       --items pallas_identity,pallas_band,bench_packed,ltl_bosco,generations_brain,config5_sparse
@@ -14,7 +24,7 @@ for i in $(seq 1 60); do
     echo "=== capture done at $(date -u +%H:%M:%S) ==="
     exit 0
   fi
-  echo "probe $i: not healthy at $(date -u +%H:%M:%S)"
+  echo "probe $i: $(head -c 60 "$PROBE_OUT") at $(date -u +%H:%M:%S)"
   sleep 300
 done
 echo "gave up after 60 probes"

@@ -1,0 +1,38 @@
+"""Smoke tests: every example under examples/ runs end-to-end at toy sizes.
+
+Examples are product surface — a migrating user's first contact — so they
+stay green like any other code. Each runs in-process via its main(argv)
+(same pattern as the CLI tests), on the 8-fake-CPU rig from conftest.py.
+"""
+
+def test_ensemble_runs(capsys):
+    from examples.ensemble import main
+
+    main(["--batch", "2", "--side", "64", "--gens", "8", "--report-every", "4"])
+    out = capsys.readouterr().out
+    assert "gen     8" in out and "density mean" in out
+
+
+def test_checkpoint_resume_round_trip(capsys):
+    from examples.checkpoint_resume import main
+
+    main(["--side", "64", "--gens", "20"])
+    assert "resumed == uninterrupted: True" in capsys.readouterr().out
+
+
+def test_distributed_bands_both_layouts(capsys):
+    from examples.distributed_bands import main
+
+    # side must split into 32-cell words across the 2D mesh's 4 columns
+    main(["--side", "256", "--gens", "4"])
+    out = capsys.readouterr().out
+    assert "2D tiles / SWAR" in out and "row bands / native kernel" in out
+
+
+def test_sparse_gun_emits(capsys):
+    from examples.sparse_gun import main
+
+    main(["--side", "512", "--gens", "90", "--report-every", "90"])
+    out = capsys.readouterr().out
+    # after 90 gens the gun (36 cells) has emitted 3 gliders (5 cells each)
+    assert "pop     51" in out
