@@ -349,7 +349,9 @@ class Engine:
                  else pallas_stencil.DEFAULT_GENS_PER_CALL)
             if (on_tpu and ny == 1 and topology is Topology.TORUS
                     and th > 0
-                    and pallas_stencil.band_supported(th, g, native=True)
+                    and pallas_stencil.band_supported(
+                        th, g, native=True,
+                        wp=shape[1] // bitpack.WORD)
                     and pallas_stencil.supported(
                         (shape[0], shape[1] // bitpack.WORD), on_tpu=True)):
                 return "pallas"

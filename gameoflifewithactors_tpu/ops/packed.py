@@ -72,14 +72,19 @@ def _count_eq(bits: Sequence[jax.Array], n: int) -> jax.Array:
 
 
 def apply_rule_planes(alive: jax.Array, bits: Sequence[jax.Array], rule: Rule) -> jax.Array:
-    """Next-generation plane from the alive plane + count bit-planes."""
+    """Next-generation plane from the alive plane + count bit-planes.
+
+    Counts shared between the born and survive sets (3 for Conway) are
+    materialized once — the equality planes are the second-largest op block
+    after the adder network."""
+    eq = {n: _count_eq(bits, n) for n in set(rule.born) | set(rule.survive)}
     zero = jnp.zeros_like(alive)
     born = zero
     for n in sorted(rule.born):
-        born = born | _count_eq(bits, n)
+        born = born | eq[n]
     keep = zero
     for n in sorted(rule.survive):
-        keep = keep | _count_eq(bits, n)
+        keep = keep | eq[n]
     return (alive & keep) | (~alive & born)
 
 

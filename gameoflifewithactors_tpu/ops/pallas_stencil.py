@@ -13,8 +13,11 @@ Layout/contract:
 - packed uint32 grid (H, W/32), same bit layout as ops/bitpack.py;
 - vertical halos come via 3 contiguous async DMAs (top-wrap, body,
   bottom-wrap — the wrap segments are contiguous because g <= bh and
-  H % bh == 0); horizontal wrap is in-VMEM word rolls, so the full row
-  width must live in one block (Wp fits VMEM for grids up to ~1M columns);
+  H % bh == 0), double-buffered so block i+1's copies overlap block i's
+  compute; horizontal wrap is in-VMEM word rolls, so the full row width
+  must live in one block (the VMEM-aware block picker shortens blocks for
+  wide grids; supported() caps width at ~1.8M cells where even 8-row
+  blocks exceed the budget);
 - TORUS is handled by the wrapped DMAs; DEAD re-zeroes the exterior rows
   of boundary blocks before every in-slab generation (exterior cells are
   *permanently* dead — they must not evolve with the slab);
@@ -87,31 +90,52 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
     n_blocks = H // bh
     L = bh + 2 * g
 
-    def kernel(p_hbm, out_ref, slab_ref, sems):
-        i = pl.program_id(0)
-        base = i * bh
-        # 3 contiguous segments (wrap segments are contiguous since g <= bh).
-        # Mosaic must prove the dynamic row offsets divisible by the (8, 128)
-        # sublane tiling; the jnp.where obscures that, so assert it with
-        # multiple_of (sound: H, bh, g are all multiples of 8 natively). In
-        # slab mode the wrap formula is only an arbitrary aligned in-range
-        # window — its payload is zeroed below.
-        top = pl.multiple_of(jnp.where(i == 0, H - g, base - g), 8)
-        bot = pl.multiple_of(jnp.where(i == n_blocks - 1, 0, base + bh), 8)
-        d_top = pltpu.make_async_copy(
-            p_hbm.at[pl.ds(top, g)], slab_ref.at[pl.ds(0, g)], sems.at[0])
-        d_mid = pltpu.make_async_copy(
-            p_hbm.at[pl.ds(base, bh)], slab_ref.at[pl.ds(g, bh)], sems.at[1])
-        d_bot = pltpu.make_async_copy(
-            p_hbm.at[pl.ds(bot, g)], slab_ref.at[pl.ds(g + bh, g)], sems.at[2])
-        d_top.start()
-        d_mid.start()
-        d_bot.start()
-        d_top.wait()
-        d_mid.wait()
-        d_bot.wait()
+    def _block_copies(p_hbm, slab_ref, sems, j, buf):
+        """The 3 async copies assembling block ``j``'s slab into revolving
+        buffer ``buf``. 3 contiguous segments (wrap segments are contiguous
+        since g <= bh). Mosaic must prove the dynamic row offsets divisible
+        by the (8, 128) sublane tiling; the jnp.where obscures that, so
+        assert it with multiple_of (sound: H, bh, g are all multiples of 8
+        natively). In slab mode the wrap formula is only an arbitrary
+        aligned in-range window — its payload is zeroed after the wait."""
+        base = j * bh
+        top = pl.multiple_of(jnp.where(j == 0, H - g, base - g), 8)
+        bot = pl.multiple_of(jnp.where(j == n_blocks - 1, 0, base + bh), 8)
+        return (
+            pltpu.make_async_copy(
+                p_hbm.at[pl.ds(top, g)], slab_ref.at[buf, pl.ds(0, g)],
+                sems.at[buf, 0]),
+            pltpu.make_async_copy(
+                p_hbm.at[pl.ds(base, bh)], slab_ref.at[buf, pl.ds(g, bh)],
+                sems.at[buf, 1]),
+            pltpu.make_async_copy(
+                p_hbm.at[pl.ds(bot, g)], slab_ref.at[buf, pl.ds(g + bh, g)],
+                sems.at[buf, 2]),
+        )
 
-        slab = slab_ref[:]
+    def kernel(p_hbm, out_ref, slab_ref, sems):
+        # Double-buffered input pipeline: TPU grid steps run sequentially
+        # and scratch/semaphores persist across them, so block i+1's slab
+        # DMA (started here) overlaps block i's g-generation compute and is
+        # waited on by grid step i+1. Output copies are pallas-managed
+        # (blocked out_specs) and already pipelined by Mosaic.
+        i = pl.program_id(0)
+        buf = jax.lax.rem(i, 2)
+
+        @pl.when(i == 0)
+        def _prologue():
+            for c in _block_copies(p_hbm, slab_ref, sems, i, buf):
+                c.start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _prefetch():
+            for c in _block_copies(p_hbm, slab_ref, sems, i + 1, 1 - buf):
+                c.start()
+
+        for c in _block_copies(p_hbm, slab_ref, sems, i, buf):
+            c.wait()
+
+        slab = slab_ref[buf]
         if slab_mode:
             for k in range(g):
                 if k == 0:
@@ -140,8 +164,8 @@ def _build_slab_runner(rule: Rule, topology: Topology, ext_shape, bh: int,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((L, Wp), jnp.uint32),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((2, L, Wp), jnp.uint32),      # revolving slab buffers
+            pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=interpret,
     )
@@ -164,7 +188,8 @@ def make_pallas_slab_step(
     checker cannot type the kernel's scratch-DMA primitives."""
     He, Wp = ext_shape
     g = int(gens)
-    bh = block_rows or _pick_bh(He, native=not interpret, at_least=g)
+    bh = block_rows or _pick_bh(He, native=not interpret, at_least=g,
+                                g=g, Wp=Wp)
     if He % bh:
         raise ValueError(f"extended height {He} not divisible by block rows {bh}")
     if g > bh:
@@ -182,12 +207,14 @@ def make_pallas_slab_step(
     return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret)
 
 
-def band_supported(band_rows: int, g: int, *, native: bool) -> bool:
+def band_supported(band_rows: int, g: int, *, native: bool,
+                   wp: int = 0) -> bool:
     """Whether the slab kernel can run a ``band_rows``-row band with a
     depth-``g`` exchange: alignment (band % 8, g % 8 native), exchange depth
     within the band, and a block decomposition of the extended height with
-    blocks >= g rows must exist. Engine's auto resolution gates on this so
-    'auto' never selects a configuration the kernel would reject."""
+    blocks >= g rows must exist (within the VMEM budget when ``wp`` is
+    given). Engine's auto resolution gates on this so 'auto' never selects
+    a configuration the kernel would reject."""
     if g < 1 or g > band_rows:
         return False
     if native and (band_rows % 8 or g % 8):
@@ -195,7 +222,7 @@ def band_supported(band_rows: int, g: int, *, native: bool) -> bool:
     try:
         # raises when no divisor of the extended height is >= g (the DMA
         # contiguity floor) — a returned bh always satisfies g <= bh
-        _pick_bh(band_rows + 2 * g, native=native, at_least=g)
+        _pick_bh(band_rows + 2 * g, native=native, at_least=g, g=g, Wp=wp)
     except ValueError:
         return False
     return True
@@ -205,12 +232,15 @@ def supported(shape, *, on_tpu: bool) -> bool:
     """Whether the kernel can run this packed (H, Wp) shape natively.
 
     The TPU lane (last) dimension must be a multiple of 128 words (= 4096
-    cells of width) and the height a multiple of 8 (sublane tiling, so a
-    block decomposition with 8-aligned DMA offsets exists); interpret mode
-    (CPU) has no constraint.
+    cells of width), the height a multiple of 8 (sublane tiling, so a
+    block decomposition with 8-aligned DMA offsets exists), and even the
+    shortest legal block (8 rows) must fit the double-buffered VMEM budget
+    — widths up to ~1.8M cells; interpret mode (CPU) has no constraint.
     """
     H, Wp = shape
-    return not on_tpu or (Wp % 128 == 0 and H % 8 == 0)
+    return not on_tpu or (
+        Wp % 128 == 0 and H % 8 == 0
+        and _vmem_bytes(8, DEFAULT_GENS_PER_CALL, Wp) <= _VMEM_BUDGET)
 
 
 def default_interpret() -> bool:
@@ -218,22 +248,38 @@ def default_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _pick_bh(H: int, native: bool = False, at_least: int = 1) -> int:
+_VMEM_BUDGET = 14 * 1024 * 1024  # headroom under the ~16 MiB/core VMEM
+
+
+def _vmem_bytes(bh: int, g: int, Wp: int) -> int:
+    """Kernel VMEM footprint: two revolving (bh+2g, Wp) slab buffers plus
+    the Mosaic-double-buffered (bh, Wp) output block, uint32 words."""
+    return (2 * (bh + 2 * g) + 2 * bh) * Wp * 4
+
+
+def _pick_bh(H: int, native: bool = False, at_least: int = 1,
+             g: int = DEFAULT_GENS_PER_CALL, Wp: int = 0) -> int:
     """Largest block height <= max(DEFAULT_BLOCK_ROWS, at_least) dividing H
     (8-aligned when targeting real Mosaic, see the multiple_of hints in the
-    kernel), and >= ``at_least`` (the slab path's DMA scheme needs blocks at
-    least as tall as the exchange depth)."""
+    kernel), >= ``at_least`` (the slab path's DMA scheme needs blocks at
+    least as tall as the exchange depth), and — when ``Wp`` is given —
+    fitting the double-buffered VMEM budget (wide grids get shorter
+    blocks instead of a Mosaic allocation failure)."""
     bh = min(max(DEFAULT_BLOCK_ROWS, at_least), H)
     step = 1
     if native:
         bh -= bh % 8
         step = 8
-    while bh >= max(at_least, 1) and H % bh:
+    floor = max(at_least, 1)
+    while bh >= floor and (
+            H % bh or (Wp and _vmem_bytes(bh, g, Wp) > _VMEM_BUDGET)):
         bh -= step
-    if bh < max(at_least, 1):
+    if bh < floor:
         raise ValueError(
             f"no usable block height for grid height {H}"
-            + (f" with blocks >= {at_least} rows" if at_least > 1 else ""))
+            + (f" with blocks >= {at_least} rows" if at_least > 1 else "")
+            + (f" within the {_VMEM_BUDGET >> 20} MiB VMEM budget at "
+               f"width {Wp * 32} cells" if Wp else ""))
     return bh
 
 
@@ -254,8 +300,8 @@ def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((L, Wp), jnp.uint32),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((2, L, Wp), jnp.uint32),      # revolving slab buffers
+            pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=interpret,
     )
@@ -284,7 +330,9 @@ def make_pallas_step(
     ``donate=True`` hands the caller's buffer to the loop (owners only).
     """
     H, Wp = shape
-    bh = block_rows or _pick_bh(H, native=not interpret)
+    bh = block_rows or _pick_bh(
+        H, native=not interpret,
+        g=gens_per_call or DEFAULT_GENS_PER_CALL, Wp=Wp)
     g = min(gens_per_call or DEFAULT_GENS_PER_CALL, bh)
     if H % bh:
         raise ValueError(f"grid height {H} not divisible by block rows {bh}")
