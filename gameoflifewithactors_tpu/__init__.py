@@ -28,6 +28,18 @@ from .models.generations import (  # noqa: F401
     parse_generations,
 )
 from .models.ltl import BOSCO, LTL_REGISTRY, LtLRule, parse_ltl  # noqa: F401
+from .models.elementary import (  # noqa: F401
+    RULE_30,
+    RULE_90,
+    RULE_110,
+    ElementaryRule,
+    parse_elementary,
+)
+from .ops.elementary import (  # noqa: F401
+    evolve_spacetime,
+    multi_step_elementary,
+    step_elementary,
+)
 from .ops.generations import multi_step_generations, step_generations  # noqa: F401
 from .ops.ltl import multi_step_ltl, step_ltl  # noqa: F401
 from .ops.stencil import Topology, step, multi_step  # noqa: F401

@@ -90,6 +90,14 @@ class Engine:
             raise ValueError(
                 f"backend must be 'auto' or one of {BACKENDS}, got {backend!r}")
         self.rule = parse_any(rule)
+        from .models.elementary import ElementaryRule
+
+        if isinstance(self.rule, ElementaryRule):
+            raise ValueError(
+                f"{self.rule.notation} is a 1D (elementary) rule; the Engine "
+                "drives 2D grids. Use ops.elementary directly: "
+                "multi_step_elementary / evolve_spacetime on a packed row "
+                "(see examples/wolfram.py)")
         self._generations = isinstance(self.rule, GenRule)
         self._ltl = isinstance(self.rule, LtLRule)
         if backend == "auto":

@@ -113,13 +113,16 @@ def parse_any(spec):
     of the spec — a string matching a family's form dispatches to that
     family's parser so validation errors (e.g. a bad state count) surface
     verbatim instead of degrading to 'unrecognized rule'."""
+    from .elementary import _ELEM_RE, ElementaryRule, parse_elementary
     from .ltl import _LTL_RE, LTL_REGISTRY, LtLRule, parse_ltl
 
-    if isinstance(spec, (Rule, GenRule, LtLRule)):
+    if isinstance(spec, (Rule, GenRule, LtLRule, ElementaryRule)):
         return spec
     key = spec.strip().lower().replace(" ", "").replace("'", "")
     if key in GENERATIONS_REGISTRY or _GEN_RE.match(key):
         return parse_generations(spec)
     if key in LTL_REGISTRY or _LTL_RE.match(key):
         return parse_ltl(spec)
+    if _ELEM_RE.match(key):
+        return parse_elementary(spec)
     return parse_rule(spec)

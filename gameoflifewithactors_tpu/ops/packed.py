@@ -112,8 +112,10 @@ def _row_triplet(p: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array
 
 
 def horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """(west, center, east) planes of a row-aligned slab, with cross-word
-    carries; word columns wrap for TORUS and see zeros for DEAD.
+    """(west, center, east) planes along the packed LAST axis, with
+    cross-word carries; word columns wrap for TORUS and see zeros for DEAD.
+    Serves 2D (rows, words) slabs and the 1D family's (..., words) rows
+    alike — the word axis is always last.
 
     DEAD is a roll + edge-column mask rather than a concatenate of
     unaligned slices: a lane-dimension concat has no Mosaic lowering
@@ -121,12 +123,13 @@ def horizontal_planes(slab: jax.Array, topology: Topology) -> Tuple[jax.Array, j
     (tpu.rotate) + iota select compiles in the Pallas kernel and fuses
     just as well under plain XLA.
     """
-    left = jnp.roll(slab, 1, axis=1)
-    right = jnp.roll(slab, -1, axis=1)
+    axis = slab.ndim - 1
+    left = jnp.roll(slab, 1, axis=axis)
+    right = jnp.roll(slab, -1, axis=axis)
     if topology is not Topology.TORUS:
-        cols = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, slab.shape, axis)
         left = jnp.where(cols == 0, jnp.uint32(0), left)
-        right = jnp.where(cols == slab.shape[1] - 1, jnp.uint32(0), right)
+        right = jnp.where(cols == slab.shape[-1] - 1, jnp.uint32(0), right)
     return _shift_west(slab, left), slab, _shift_east(slab, right)
 
 

@@ -1,0 +1,100 @@
+"""Elementary (Wolfram) 1D CA family: exhaustive oracle + known structure."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from gameoflifewithactors_tpu.models.elementary import (
+    RULE_90,
+    RULE_110,
+    ElementaryRule,
+    parse_elementary,
+)
+from gameoflifewithactors_tpu.models.generations import parse_any
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.elementary import (
+    evolve_spacetime,
+    multi_step_elementary,
+    step_elementary,
+)
+from gameoflifewithactors_tpu.ops.stencil import Topology
+
+
+def _oracle(row: np.ndarray, rule: ElementaryRule, topology: Topology) -> np.ndarray:
+    if topology is Topology.TORUS:
+        left = np.roll(row, 1)
+        right = np.roll(row, -1)
+    else:
+        left = np.concatenate([[0], row[:-1]])
+        right = np.concatenate([row[1:], [0]])
+    idx = (left << 2) | (row << 1) | right
+    return ((rule.number >> idx) & 1).astype(np.uint8)
+
+
+@pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+def test_all_256_rules_match_oracle(topology):
+    """One random row through every Wolfram rule vs the numpy oracle —
+    the full rule table in one sweep (SURVEY.md §5 'unit: rule tables')."""
+    rng = np.random.default_rng(7)
+    row = rng.integers(0, 2, size=96, dtype=np.uint8)
+    p = bitpack.pack(jnp.asarray(row[None]))
+    for n in range(256):
+        rule = ElementaryRule(n)
+        got = np.asarray(bitpack.unpack(
+            step_elementary(p, rule=rule, topology=topology)))[0]
+        np.testing.assert_array_equal(got, _oracle(row, rule, topology),
+                                      err_msg=f"rule {n}")
+
+
+def test_rule_90_is_xor_and_sierpinski():
+    # rule 90: next = left XOR right; a single cell grows the Sierpinski
+    # triangle — row t has popcount 2^(ones in binary t)
+    row = np.zeros(256, dtype=np.uint8)
+    row[128] = 1
+    # spacetime of one universe: (T+1, 1, Wp) -> squeeze -> (T+1, Wp),
+    # which unpacks as a 2D image whose row t is generation t
+    st = np.asarray(bitpack.unpack(evolve_spacetime(
+        bitpack.pack(jnp.asarray(row[None])), 63, rule=RULE_90)[:, 0, :]))
+    for t in (1, 2, 3, 4, 7, 15, 31, 63):
+        assert st[t].sum() == 2 ** bin(t).count("1"), t
+
+
+def test_rows_are_independent_universes():
+    """An (H, Wp) array steps H separate 1D worlds: stacked == separate."""
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 2, size=(4, 64), dtype=np.uint8)
+    p = bitpack.pack(jnp.asarray(rows))
+    got = np.asarray(bitpack.unpack(
+        multi_step_elementary(p, 16, rule=RULE_110)))
+    for i in range(4):
+        want = rows[i]
+        for _ in range(16):
+            want = _oracle(want, RULE_110, Topology.TORUS)
+        np.testing.assert_array_equal(got[i], want, err_msg=f"row {i}")
+
+
+def test_parse_and_dispatch():
+    assert parse_elementary("W110") == RULE_110
+    assert parse_elementary("rule 90").number == 90
+    assert parse_any("w30").number == 30
+    assert parse_any("W110").notation == "W110"
+    with pytest.raises(ValueError, match="0..255"):
+        parse_elementary("W300")
+    with pytest.raises(ValueError):
+        parse_elementary("B3/S23")
+    # 2D families still dispatch past the elementary matcher
+    assert parse_any("B3/S23").notation == "B3/S23"
+
+
+def test_spacetime_shape_and_initial_row():
+    p = bitpack.pack(jnp.asarray(np.ones((1, 32), np.uint8)))
+    st = evolve_spacetime(p, 5, rule=RULE_110)
+    assert st.shape == (6, 1, 1)
+    np.testing.assert_array_equal(np.asarray(st[0]), np.asarray(p))
+
+
+def test_engine_rejects_1d_rules():
+    from gameoflifewithactors_tpu import Engine
+
+    with pytest.raises(ValueError, match="1D .*elementary.* rule"):
+        Engine(np.zeros((8, 32), np.uint8), "W110")

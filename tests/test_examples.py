@@ -36,3 +36,13 @@ def test_sparse_gun_emits(capsys):
     out = capsys.readouterr().out
     # after 90 gens the gun (36 cells) has emitted 3 gliders (5 cells each)
     assert "pop     51" in out
+
+
+def test_wolfram_sierpinski(capsys):
+    from examples.wolfram import main
+
+    main(["--rule", "W90", "--width", "64", "--steps", "16"])
+    out = capsys.readouterr().out
+    assert "W90: 16 generations" in out
+    # generation 16 of rule 90 has exactly 2 live cells (2^popcount(16))
+    assert out.splitlines()[16].count("#") == 2
