@@ -138,6 +138,12 @@ def run_bench(args) -> None:
     platform = jax.devices()[0].platform
     side = args.size or (16384 if platform != "cpu" else 4096)
     rule = parse_any(args.rule)
+    from gameoflifewithactors_tpu.models.elementary import ElementaryRule
+
+    if isinstance(rule, ElementaryRule):
+        raise SystemExit(
+            f"{rule.notation} is a 1D (elementary) rule; this bench times 2D "
+            "grids. Drive ops.elementary directly (see examples/wolfram.py)")
     explicitly_packed = args.backend == "packed"
     if args.backend == "auto":
         # pallas (temporal-blocked Mosaic kernel, ~2.8x the XLA SWAR rate on
@@ -164,8 +170,10 @@ def run_bench(args) -> None:
         _route_rule(True, "bit-plane packed")
     elif isinstance(rule, LtLRule) and args.backend != "dense":
         # LtL: bit-sliced packed path on TPU (or when explicitly requested),
-        # byte path elsewhere (2.4x faster under CPU XLA — engine routing)
-        _route_rule(explicitly_packed or platform == "tpu", "bit-sliced packed")
+        # byte path elsewhere (2.4x faster under CPU XLA — engine routing);
+        # diamond (von Neumann) rules are dense-only
+        _route_rule((explicitly_packed or platform == "tpu")
+                    and rule.neighborhood == "M", "bit-sliced packed")
 
     def sync(x) -> int:
         """Force completion: block (a no-op on the tunnel), then fetch a

@@ -29,12 +29,14 @@ def main(argv=None) -> None:
     import jax
     import jax.numpy as jnp
 
-    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.models.rules import parse_rule
     from gameoflifewithactors_tpu.ops import bitpack
     from gameoflifewithactors_tpu.ops.packed import multi_step_packed
     from gameoflifewithactors_tpu.ops.stencil import Topology
 
-    rule = parse_any(args.rule)
+    # this example batches the life-like SWAR path; parse_rule rejects
+    # other families with a clear error instead of parse_any's pass-through
+    rule = parse_rule(args.rule)
     rng = np.random.default_rng(0)
     grids = rng.integers(0, 2, size=(args.batch, args.side, args.side),
                          dtype=np.uint8)

@@ -138,7 +138,20 @@ class Engine:
         # checkpoint); sharded tiles exchange r-row + 1-word halos
         _ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
         _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
-        self._ltl_packed = self._ltl and backend == "packed" and _packs
+        self._ltl_packed = (self._ltl and backend == "packed" and _packs
+                            and self.rule.neighborhood == "M")
+        if self._ltl and backend == "packed" and not self._ltl_packed:
+            # the bit-sliced path can't serve this rule/shape (diamond
+            # neighborhood, or width not packing into whole words): fall
+            # back to the byte path and SAY so — self.backend reports what
+            # actually runs, matching ops.packed_ltl's explicit raise
+            warnings.warn(
+                f"packed LtL unavailable for {self.rule.notation} on "
+                f"{self.shape} (Moore-box + word-divisible widths only); "
+                "running the dense byte path",
+                stacklevel=3,
+            )
+            self.backend = backend = "dense"
         self._packed = (backend in ("packed", "pallas", "sparse")
                         and not (self._generations or self._ltl)
                         ) or self._ltl_packed
@@ -147,6 +160,16 @@ class Engine:
         # layout; shards as P(None, x, y) with per-plane halo exchange
         self._gen_packed = (self._generations and backend == "packed"
                             and _packs)
+        if self._generations and backend == "packed" and not self._gen_packed:
+            # same honesty as the LtL fallback: the bit-plane stack needs
+            # word-divisible widths; report the byte path that actually runs
+            warnings.warn(
+                f"bit-plane Generations unavailable for width {self.shape[1]}"
+                " (32-cell words must shard whole); running the dense byte "
+                "path",
+                stacklevel=3,
+            )
+            self.backend = backend = "dense"
         self._sparse = None
         self._flags = None
         if mesh is not None:
@@ -329,11 +352,14 @@ class Engine:
         if self._ltl:
             # the bit-sliced LtL path wins on the TPU VPU but measured
             # ~2.4x slower than the byte path under XLA's CPU lowering;
-            # pick per platform (explicit backend='packed' still forces it)
+            # pick per platform (explicit backend='packed' still forces it).
+            # Diamond (von Neumann) rules are dense-only — the bit-sliced
+            # path is built from separable box sums.
             on_tpu = not pallas_stencil.default_interpret()
             shape = np.shape(grid)
             if (on_tpu and len(shape) == 2
-                    and shape[1] % bitpack.WORD == 0):
+                    and shape[1] % bitpack.WORD == 0
+                    and self.rule.neighborhood == "M"):
                 return "packed"
             return "dense"
         if self._generations:

@@ -25,12 +25,15 @@ MAX_RADIUS = 7  # policy cap (int32 tree is exact at any radius): keeps
 
 @dataclasses.dataclass(frozen=True)
 class LtLRule:
-    """Binary Larger-than-Life: interval birth/survival over a radius-r box."""
+    """Binary Larger-than-Life: interval birth/survival over a radius-r
+    neighborhood — Moore box ("M", Golly's NM) or von Neumann diamond
+    ("N", Golly's NN, |dx|+|dy| <= r)."""
 
     radius: int
     born: Tuple[int, int]       # inclusive [lo, hi]
     survive: Tuple[int, int]    # inclusive [lo, hi]
     middle: bool = True         # M1: a live cell counts itself in its window
+    neighborhood: str = "M"     # "M" box | "N" von Neumann diamond
 
     def __post_init__(self):
         if not 1 <= self.radius <= MAX_RADIUS:
@@ -38,13 +41,24 @@ class LtLRule:
                 f"radius must be 1..{MAX_RADIUS} (bf16-exact window sums), "
                 f"got {self.radius}"
             )
-        full = (2 * self.radius + 1) ** 2
+        if self.neighborhood not in ("M", "N"):
+            raise ValueError(
+                f"neighborhood must be 'M' (Moore box) or 'N' (von Neumann "
+                f"diamond), got {self.neighborhood!r}")
+        full = self.window_size
         for name, (lo, hi) in (("born", self.born), ("survive", self.survive)):
             if not (0 <= lo <= hi <= full):
                 raise ValueError(
                     f"{name} interval {lo}..{hi} outside 0..{full} "
-                    f"for radius {self.radius}"
+                    f"for radius {self.radius} neighborhood {self.neighborhood}"
                 )
+
+    @property
+    def window_size(self) -> int:
+        """Cells in the neighborhood window (center included)."""
+        r = self.radius
+        return (2 * r + 1) ** 2 if self.neighborhood == "M" else (
+            2 * r * (r + 1) + 1)
 
     @property
     def notation(self) -> str:
@@ -52,6 +66,7 @@ class LtLRule:
             f"R{self.radius},C0,M{int(self.middle)},"
             f"S{self.survive[0]}..{self.survive[1]},"
             f"B{self.born[0]}..{self.born[1]}"
+            + ("" if self.neighborhood == "M" else ",NN")
         )
 
     def __str__(self) -> str:
@@ -60,7 +75,8 @@ class LtLRule:
 
 _LTL_RE = re.compile(
     r"^R(?P<r>\d+),C(?P<c>\d+),M(?P<m>[01]),"
-    r"S(?P<s1>\d+)\.\.(?P<s2>\d+),B(?P<b1>\d+)\.\.(?P<b2>\d+)$",
+    r"S(?P<s1>\d+)\.\.(?P<s2>\d+),B(?P<b1>\d+)\.\.(?P<b2>\d+)"
+    r"(?:,N(?P<n>[MN]))?$",
     re.IGNORECASE,
 )
 
@@ -95,6 +111,7 @@ def parse_ltl(spec: "str | LtLRule") -> LtLRule:
         born=(int(m.group("b1")), int(m.group("b2"))),
         survive=(int(m.group("s1")), int(m.group("s2"))),
         middle=m.group("m") == "1",
+        neighborhood=(m.group("n") or "m").upper(),
     )
 
 

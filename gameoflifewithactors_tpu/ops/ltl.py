@@ -69,11 +69,37 @@ def box_sums_ext(ext: jax.Array, radius: int) -> jax.Array:
     return sliding_sum(sliding_sum(x, k, axis=0), k, axis=1)
 
 
+def diamond_sums_ext(ext: jax.Array, radius: int) -> jax.Array:
+    """(h+2r, w+2r) {0,1} tile -> (h, w) int32 von Neumann (|dx|+|dy| <= r)
+    window sums, center included.
+
+    The diamond is not separable, but per-row it is still an interval whose
+    half-width a = r - |dv| varies with the row offset — so one prefix-sum
+    pass along the row axis turns every row's contribution into a
+    two-slice difference, and the vertical assembly is 2r+1 adds. All
+    static slices; exact in int32.
+    """
+    r = radius
+    h, w = ext.shape[0] - 2 * r, ext.shape[1] - 2 * r
+    pref = jnp.pad(jnp.cumsum(ext.astype(jnp.int32), axis=1), ((0, 0), (1, 0)))
+    total = None
+    for dv in range(-r, r + 1):
+        a = r - abs(dv)
+        rows = lax.slice_in_dim(pref, r + dv, r + dv + h, axis=0)
+        # interior column j maps to ext column j+r; the width-(2a+1)
+        # interval [j+r-a, j+r+a] is pref[j+r+a+1] - pref[j+r-a]
+        s = (lax.slice_in_dim(rows, r + a + 1, r + a + 1 + w, axis=1)
+             - lax.slice_in_dim(rows, r - a, r - a + w, axis=1))
+        total = s if total is None else total + s
+    return total
+
+
 def step_ltl_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
     """One generation from a halo-extended (h+2r, w+2r) uint8 tile."""
     r = rule.radius
     state = ext[r:-r, r:-r]
-    sums = box_sums_ext(ext, r)
+    sums = (box_sums_ext(ext, r) if rule.neighborhood == "M"
+            else diamond_sums_ext(ext, r))
     count = sums - (0 if rule.middle else state.astype(jnp.int32))
     alive = state.astype(bool)
     (b1, b2), (s1, s2) = rule.born, rule.survive

@@ -186,8 +186,19 @@ def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> ja
     return born | keep
 
 
+def _require_box(rule: LtLRule) -> None:
+    """The bit-sliced path is built from separable box sums; von Neumann
+    (diamond) rules take the dense prefix-sum path (ops/ltl.py)."""
+    if rule.neighborhood != "M":
+        raise ValueError(
+            f"the packed LtL path supports Moore-box neighborhoods only "
+            f"(got {rule.notation}); use the dense path "
+            f"(backend='dense' / ops.ltl) for von Neumann rules")
+
+
 def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
     """One generation on a (H, W/32) packed binary grid."""
+    _require_box(rule)
     return _apply_intervals(p, box_counts_packed(p, rule.radius, topology), rule)
 
 
@@ -199,6 +210,7 @@ def step_ltl_packed_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
     sharded runner's ppermute exchange). Counts are computed with DEAD
     closure on the slab — every interior cell's (2r+1)² box lies inside
     the ext, so the closure never touches a real contribution."""
+    _require_box(rule)
     r = rule.radius
     counts = [c[r:-r, 1:-1] for c in box_counts_packed(ext, r, Topology.DEAD)]
     return _apply_intervals(ext[r:-r, 1:-1], counts, rule)

@@ -194,3 +194,83 @@ def test_sliding_sum_full_width_and_bounds():
         sliding_sum(x, 5, axis=1)
     with pytest.raises(ValueError):
         sliding_sum(x, 0, axis=1)
+
+
+class TestVonNeumann:
+    """Diamond (|dx|+|dy| <= r) neighborhoods: Golly's NN field."""
+
+    @staticmethod
+    def _oracle(grid, rule):
+        """Brute-force diamond step with torus wrap."""
+        h, w = grid.shape
+        r = rule.radius
+        out = np.zeros_like(grid)
+        for y in range(h):
+            for x in range(w):
+                c = 0
+                for dv in range(-r, r + 1):
+                    for dh in range(-(r - abs(dv)), r - abs(dv) + 1):
+                        c += grid[(y + dv) % h, (x + dh) % w]
+                if not rule.middle:
+                    c -= grid[y, x]
+                alive = grid[y, x]
+                (b1, b2), (s1, s2) = rule.born, rule.survive
+                out[y, x] = ((not alive and b1 <= c <= b2)
+                             or (alive and s1 <= c <= s2))
+        return out
+
+    @pytest.mark.parametrize("r,m", [(1, True), (2, True), (3, False)])
+    def test_matches_brute_force_oracle(self, r, m):
+        rule = LtLRule(radius=r, born=(2, 4), survive=(3, min(6, 2 * r * (r + 1))),
+                       middle=m, neighborhood="N")
+        rng = np.random.default_rng(13)
+        grid = rng.integers(0, 2, size=(18, 22), dtype=np.uint8)
+        want = self._oracle(grid, rule)
+        got = np.asarray(multi_step_ltl(jnp.asarray(grid), 1, rule=rule,
+                                        topology=Topology.TORUS))
+        np.testing.assert_array_equal(got, want)
+
+    def test_radius_1_diamond_is_von_neumann_gol(self):
+        # R1 diamond, M0: the 4-neighbor von Neumann neighborhood
+        rule = parse_ltl("R1,C0,M0,S1..2,B2..2,NN")
+        assert rule.neighborhood == "N"
+        assert rule.window_size == 5
+        grid = np.zeros((8, 8), np.uint8)
+        grid[3, 3] = grid[3, 4] = grid[4, 3] = 1  # L-tromino
+        got = np.asarray(multi_step_ltl(jnp.asarray(grid), 1, rule=rule))
+        np.testing.assert_array_equal(got, self._oracle(grid, rule))
+
+    def test_notation_round_trip_and_window(self):
+        rule = parse_ltl("R3,C0,M1,S5..12,B6..9,NN")
+        assert rule.notation == "R3,C0,M1,S5..12,B6..9,NN"
+        assert parse_ltl(rule.notation) == rule
+        assert rule.window_size == 2 * 3 * 4 + 1  # 25-cell diamond
+        # Moore form stays suffix-free and unchanged
+        assert parse_ltl("R3,C0,M1,S5..12,B6..9,NM").notation == \
+            "R3,C0,M1,S5..12,B6..9"
+        # interval cap uses the diamond size, not the box size
+        with pytest.raises(ValueError, match="outside 0..25"):
+            LtLRule(radius=3, born=(0, 30), survive=(1, 2), neighborhood="N")
+
+    def test_packed_path_rejects_diamond(self):
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        rule = parse_ltl("R2,C0,M1,S2..6,B3..5,NN")
+        p = bitpack.pack(jnp.zeros((8, 32), jnp.uint8))
+        with pytest.raises(ValueError, match="Moore-box"):
+            multi_step_ltl_packed(p, 1, rule=rule)
+
+    def test_engine_and_sharded_dense_path(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        rule = parse_ltl("R2,C0,M1,S2..6,B3..5,NN")
+        rng = np.random.default_rng(23)
+        grid = rng.integers(0, 2, size=(32, 64), dtype=np.uint8)
+        single = Engine(grid, rule)              # auto -> dense off-TPU
+        assert single.backend == "dense"
+        sharded_e = Engine(grid, rule, mesh=mesh_lib.make_mesh((2, 4)))
+        single.step(6)
+        sharded_e.step(6)
+        np.testing.assert_array_equal(single.snapshot(), sharded_e.snapshot())
