@@ -100,6 +100,7 @@ class Engine:
                 "(see examples/wolfram.py)")
         self._generations = isinstance(self.rule, GenRule)
         self._ltl = isinstance(self.rule, LtLRule)
+        explicit_packed = backend == "packed"  # vs auto-resolved below
         if backend == "auto":
             backend = self._resolve_auto(grid, mesh, topology, gens_per_exchange)
         if gens_per_exchange < 1:
@@ -142,15 +143,18 @@ class Engine:
                             and self.rule.neighborhood == "M")
         if self._ltl and backend == "packed" and not self._ltl_packed:
             # the bit-sliced path can't serve this rule/shape (diamond
-            # neighborhood, or width not packing into whole words): fall
-            # back to the byte path and SAY so — self.backend reports what
-            # actually runs, matching ops.packed_ltl's explicit raise
-            warnings.warn(
-                f"packed LtL unavailable for {self.rule.notation} on "
-                f"{self.shape} (Moore-box + word-divisible widths only); "
-                "running the dense byte path",
-                stacklevel=3,
-            )
+            # neighborhood, or width not sharding into whole words): fall
+            # back to the byte path; self.backend reports what actually
+            # runs either way, but only an EXPLICIT backend='packed'
+            # request warns — the auto resolver's fallback is by design
+            if explicit_packed:
+                warnings.warn(
+                    f"packed LtL unavailable for {self.rule.notation} on "
+                    f"{self.shape} over {_ny} mesh column(s) (Moore-box + "
+                    "word-divisible shard widths only); running the dense "
+                    "byte path",
+                    stacklevel=3,
+                )
             self.backend = backend = "dense"
         self._packed = (backend in ("packed", "pallas", "sparse")
                         and not (self._generations or self._ltl)
@@ -161,14 +165,15 @@ class Engine:
         self._gen_packed = (self._generations and backend == "packed"
                             and _packs)
         if self._generations and backend == "packed" and not self._gen_packed:
-            # same honesty as the LtL fallback: the bit-plane stack needs
-            # word-divisible widths; report the byte path that actually runs
-            warnings.warn(
-                f"bit-plane Generations unavailable for width {self.shape[1]}"
-                " (32-cell words must shard whole); running the dense byte "
-                "path",
-                stacklevel=3,
-            )
+            # same honesty as the LtL fallback: report the byte path that
+            # actually runs, warn only on explicit requests
+            if explicit_packed:
+                warnings.warn(
+                    f"bit-plane Generations unavailable for width "
+                    f"{self.shape[1]} over {_ny} mesh column(s) (32-cell "
+                    "words must shard whole); running the dense byte path",
+                    stacklevel=3,
+                )
             self.backend = backend = "dense"
         self._sparse = None
         self._flags = None
@@ -354,11 +359,14 @@ class Engine:
             # ~2.4x slower than the byte path under XLA's CPU lowering;
             # pick per platform (explicit backend='packed' still forces it).
             # Diamond (von Neumann) rules are dense-only — the bit-sliced
-            # path is built from separable box sums.
+            # path is built from separable box sums. The width must shard
+            # into whole words across the mesh columns, or the constructor
+            # would immediately walk the choice back to dense.
             on_tpu = not pallas_stencil.default_interpret()
             shape = np.shape(grid)
+            ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
             if (on_tpu and len(shape) == 2
-                    and shape[1] % bitpack.WORD == 0
+                    and shape[1] % (bitpack.WORD * ny) == 0
                     and self.rule.neighborhood == "M"):
                 return "packed"
             return "dense"

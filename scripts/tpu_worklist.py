@@ -334,6 +334,47 @@ def child_pallas_band() -> dict:
     return out
 
 
+def child_profile_trace() -> dict:
+    """A real profiler trace of the Pallas kernel (utils/profiling.py):
+    records that the trace capture machinery works against the actual
+    chip and how much device activity one 64-generation dispatch logs —
+    the measured counterpart of Engine.halo_bytes_per_gen-style estimates."""
+    import glob
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.pallas_stencil import (
+        default_interpret,
+        multi_step_pallas,
+    )
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    interp = default_interpret()  # native on TPU; CPU smoke uses interpret
+    rng = np.random.default_rng(2)
+    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(4096, 512), dtype=np.uint32))
+    p = multi_step_pallas(p, 8, rule=CONWAY, topology=Topology.TORUS,
+                          interpret=interp)  # warm
+    _sync_scalar(p)
+    with tempfile.TemporaryDirectory() as d:
+        with jax.profiler.trace(d):
+            p = multi_step_pallas(p, 64, rule=CONWAY, topology=Topology.TORUS,
+                                  interpret=interp)
+            _sync_scalar(p)
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        sizes = {os.path.basename(f): os.path.getsize(f)
+                 for f in files if os.path.isfile(f)}
+    total = sum(sizes.values())
+    return {"ok": total > 0, "trace_bytes": total,
+            "n_files": len(sizes),
+            "largest": sorted(sizes.items(), key=lambda kv: -kv[1])[:3],
+            "platform": jax.devices()[0].platform}
+
+
 def child_config5_sparse() -> dict:
     out_path = os.path.join(_REPO, "results", "config5_sparse_65536_tpu.json")
     r = subprocess.run(
@@ -355,6 +396,7 @@ ITEMS = {
     "generations_brain": child_generations_brain,
     "ltl_lowering": child_ltl_lowering,
     "pallas_band": child_pallas_band,
+    "profile_trace": child_profile_trace,
     "config5_sparse": child_config5_sparse,
 }
 
