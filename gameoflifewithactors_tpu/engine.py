@@ -113,13 +113,17 @@ class Engine:
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto', "
                 "3x3 binary rule)")
-        if (self._generations or self._ltl) and backend in ("pallas", "sparse"):
+        if ((self._generations and backend == "sparse")
+                or (self._ltl and backend in ("pallas", "sparse"))
+                or (self._generations and backend == "pallas"
+                    and mesh is not None)):
             raise ValueError(
-                f"backend={backend!r} is 3x3-binary-only; "
-                f"{type(self.rule).__name__} rules ({self.rule.notation}) run "
-                "on their own steppers (backend='packed' is the bit-plane "
-                "stack for Generations and the bit-sliced bitboard for LtL; "
-                "backend='dense' is the byte layout)"
+                f"backend={backend!r} does not serve "
+                f"{type(self.rule).__name__} rules ({self.rule.notation}) "
+                "in this configuration: sparse is 3x3-binary-only, LtL has "
+                "no pallas kernel, and the Generations pallas kernel is "
+                "single-device (backend='packed' is the bit-plane stack / "
+                "bit-sliced bitboard; backend='dense' the byte layout)"
             )
         self.topology = topology
         self.mesh = mesh
@@ -162,12 +166,13 @@ class Engine:
         # Generations with the packed backend: bit-plane stack
         # (ops/packed_generations.py), ~4x less HBM traffic than the byte
         # layout; shards as P(None, x, y) with per-plane halo exchange
-        self._gen_packed = (self._generations and backend == "packed"
-                            and _packs)
-        if self._generations and backend == "packed" and not self._gen_packed:
+        self._gen_packed = (self._generations
+                            and backend in ("packed", "pallas") and _packs)
+        if (self._generations and backend in ("packed", "pallas")
+                and not self._gen_packed):
             # same honesty as the LtL fallback: report the byte path that
             # actually runs, warn only on explicit requests
-            if explicit_packed:
+            if explicit_packed or backend == "pallas":
                 warnings.warn(
                     f"bit-plane Generations unavailable for width "
                     f"{self.shape[1]} over {_ny} mesh column(s) (32-cell "
@@ -292,7 +297,7 @@ class Engine:
                 state, self.rule, topology=topology, **opts)
             self._run = None  # step() routes through the sparse state
             state = None  # the padded copy inside _sparse is the state now
-        elif backend == "pallas":
+        elif backend == "pallas" and not self._generations:
             # native Mosaic on TPU; interpret mode elsewhere (CPU tests)
             interpret = pallas_stencil.default_interpret()
             if not pallas_stencil.supported(state.shape, on_tpu=not interpret):
@@ -322,6 +327,33 @@ class Engine:
             self._run = lambda s, n: multi_step_ltl(
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
+        elif self._gen_packed and backend == "pallas":
+            # temporal-blocked kernel over the bit-plane stack (native on
+            # TPU, interpret elsewhere); unsupported shapes fall back to
+            # the XLA bit-plane path with a warning, like binary pallas
+            interpret = pallas_stencil.default_interpret()
+            b = state.shape[0]
+            if not pallas_stencil.supported(state.shape[1:],
+                                            on_tpu=not interpret, planes=b):
+                warnings.warn(
+                    f"pallas Generations kernel needs width % 4096 == 0 and "
+                    f"height % 8 == 0 on TPU (got "
+                    f"{self.shape[0]}x{self.shape[1]}); falling back to the "
+                    "XLA bit-plane path",
+                    stacklevel=3,
+                )
+                from .ops.packed_generations import (
+                    multi_step_packed_generations,
+                )
+
+                self._run = lambda s, n: multi_step_packed_generations(
+                    s, n, rule=self.rule, topology=self.topology, donate=True
+                )
+            else:
+                self._run = lambda s, n: (
+                    pallas_stencil.multi_step_pallas_generations(
+                        s, int(n), rule=self.rule, topology=self.topology,
+                        interpret=interpret, donate=True))
         elif self._gen_packed:
             from .ops.packed_generations import multi_step_packed_generations
 

@@ -141,6 +141,28 @@ def step_planes_ext(ext_list, rule: GenRule):
     return _transition(interior, center, bits, rule)
 
 
+def step_planes_slab(plist, rule: GenRule, topology: Topology):
+    """One generation for the interior rows of b (L, Wp) planes -> b
+    (L-2, Wp) planes — the Generations twin of ops/packed.step_packed_slab
+    (rows shrink consuming vertical halos; ``topology`` is the horizontal
+    closure across the slab's own width). Serves the temporal-blocked
+    Pallas kernel's in-VMEM generation loop."""
+    from .packed import _row_sum_bits, horizontal_planes
+
+    h = plist[0].shape[0] - 2
+    alive = _alive_of(plist)
+    w, c, e = horizontal_planes(alive, topology)
+    bits = _row_sum_bits(
+        w, c, e,
+        lambda p: (jax.lax.slice_in_dim(p, 0, h, axis=0),
+                   jax.lax.slice_in_dim(p, 2, h + 2, axis=0)),
+        lambda p: jax.lax.slice_in_dim(p, 1, h + 1, axis=0))
+    interior = tuple(jax.lax.slice_in_dim(p, 1, h + 1, axis=0) for p in plist)
+    return _transition(interior,
+                       jax.lax.slice_in_dim(alive, 1, h + 1, axis=0),
+                       bits, rule)
+
+
 def step_planes(planes: jax.Array, rule: GenRule, topology: Topology) -> jax.Array:
     """One generation on a (b, H, W/32) bit-plane stack."""
     b = planes.shape[0]

@@ -50,19 +50,70 @@ DEFAULT_BLOCK_ROWS = 512
 DEFAULT_GENS_PER_CALL = 8
 
 
-def _zero_edge_rows(slab, block_idx, n_blocks, halo):
+def _zero_edge_rows(slab, block_idx, n_blocks, halo, row_axis: int = 0):
     """Zero the outer ``halo`` rows of the first/last block's slab. Callers
     decide *when*: full-grid DEAD re-zeroes the shrinking exterior every
     generation (permanently-dead cells must not evolve); slab mode zeroes
     the out-of-range DMA payload once (dead closure beyond the exchanged
-    halo, corruption absorbed by the crop)."""
+    halo, corruption absorbed by the crop). ``row_axis`` is 0 for a 2D
+    slab, 1 for the Generations (b, L, Wp) stack."""
     if halo <= 0:
         return slab
-    L = slab.shape[0]
-    rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 0)
+    L = slab.shape[row_axis]
+    rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, row_axis)
     top_ext = (block_idx == 0) & (rows < halo)
     bot_ext = (block_idx == n_blocks - 1) & (rows >= L - halo)
     return jnp.where(top_ext | bot_ext, jnp.uint32(0), slab)
+
+
+def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks, stack: bool):
+    """The shared double-buffered 3-segment input pipeline: start block
+    i+1's copies, wait on block i's (started by the previous grid step or
+    the i == 0 prologue), return the revolving buffer index holding block
+    i. TPU grid steps run sequentially and scratch/semaphores persist
+    across them, which is what makes the hand-off sound; output copies are
+    pallas-managed (blocked out_specs) and already pipelined by Mosaic.
+
+    The 3 segments (top halo, body, bottom halo) are contiguous because
+    g <= bh. Mosaic must prove the dynamic row offsets divisible by the
+    (8, 128) sublane tiling; the jnp.where obscures that, so assert it
+    with multiple_of (sound: H, bh, g are all multiples of 8 natively).
+    In slab mode the wrap formula is only an arbitrary aligned in-range
+    window — its payload is zeroed after the wait. ``stack=True`` copies
+    the Generations (b, rows, Wp) form, whole plane axis per segment.
+    """
+    def copies(j, buf):
+        base = j * bh
+        top = pl.multiple_of(jnp.where(j == 0, H - g, base - g), 8)
+        bot = pl.multiple_of(jnp.where(j == n_blocks - 1, 0, base + bh), 8)
+        out = []
+        for k, (src, n, dst) in enumerate(
+                ((top, g, 0), (base, bh, g), (bot, g, g + bh))):
+            if stack:
+                out.append(pltpu.make_async_copy(
+                    p_hbm.at[:, pl.ds(src, n)],
+                    slab_ref.at[buf, :, pl.ds(dst, n)], sems.at[buf, k]))
+            else:
+                out.append(pltpu.make_async_copy(
+                    p_hbm.at[pl.ds(src, n)],
+                    slab_ref.at[buf, pl.ds(dst, n)], sems.at[buf, k]))
+        return out
+
+    buf = jax.lax.rem(i, 2)
+
+    @pl.when(i == 0)
+    def _prologue():
+        for c in copies(i, buf):
+            c.start()
+
+    @pl.when(i + 1 < n_blocks)
+    def _prefetch():
+        for c in copies(i + 1, 1 - buf):
+            c.start()
+
+    for c in copies(i, buf):
+        c.wait()
+    return buf
 
 
 def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
@@ -90,51 +141,10 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
     n_blocks = H // bh
     L = bh + 2 * g
 
-    def _block_copies(p_hbm, slab_ref, sems, j, buf):
-        """The 3 async copies assembling block ``j``'s slab into revolving
-        buffer ``buf``. 3 contiguous segments (wrap segments are contiguous
-        since g <= bh). Mosaic must prove the dynamic row offsets divisible
-        by the (8, 128) sublane tiling; the jnp.where obscures that, so
-        assert it with multiple_of (sound: H, bh, g are all multiples of 8
-        natively). In slab mode the wrap formula is only an arbitrary
-        aligned in-range window — its payload is zeroed after the wait."""
-        base = j * bh
-        top = pl.multiple_of(jnp.where(j == 0, H - g, base - g), 8)
-        bot = pl.multiple_of(jnp.where(j == n_blocks - 1, 0, base + bh), 8)
-        return (
-            pltpu.make_async_copy(
-                p_hbm.at[pl.ds(top, g)], slab_ref.at[buf, pl.ds(0, g)],
-                sems.at[buf, 0]),
-            pltpu.make_async_copy(
-                p_hbm.at[pl.ds(base, bh)], slab_ref.at[buf, pl.ds(g, bh)],
-                sems.at[buf, 1]),
-            pltpu.make_async_copy(
-                p_hbm.at[pl.ds(bot, g)], slab_ref.at[buf, pl.ds(g + bh, g)],
-                sems.at[buf, 2]),
-        )
-
     def kernel(p_hbm, out_ref, slab_ref, sems):
-        # Double-buffered input pipeline: TPU grid steps run sequentially
-        # and scratch/semaphores persist across them, so block i+1's slab
-        # DMA (started here) overlaps block i's g-generation compute and is
-        # waited on by grid step i+1. Output copies are pallas-managed
-        # (blocked out_specs) and already pipelined by Mosaic.
         i = pl.program_id(0)
-        buf = jax.lax.rem(i, 2)
-
-        @pl.when(i == 0)
-        def _prologue():
-            for c in _block_copies(p_hbm, slab_ref, sems, i, buf):
-                c.start()
-
-        @pl.when(i + 1 < n_blocks)
-        def _prefetch():
-            for c in _block_copies(p_hbm, slab_ref, sems, i + 1, 1 - buf):
-                c.start()
-
-        for c in _block_copies(p_hbm, slab_ref, sems, i, buf):
-            c.wait()
-
+        buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks,
+                            stack=False)
         slab = slab_ref[buf]
         if slab_mode:
             for k in range(g):
@@ -149,6 +159,98 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
         out_ref[:] = slab
 
     return kernel, n_blocks, L
+
+
+def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
+                     bh: int, g: int):
+    """Temporal-blocked kernel for the Generations bit-plane stack: the
+    (b, H, Wp) planes ride the same 3-segment double-buffered DMA scheme
+    (leading plane axis copied whole per segment), the in-VMEM loop steps
+    packed_generations.step_planes_slab, and DEAD re-zeroes the exterior
+    rows of boundary blocks every generation exactly like the binary form.
+    """
+    from .packed_generations import step_planes_slab
+
+    n_blocks = H // bh
+    L = bh + 2 * g
+
+    def kernel(p_hbm, out_ref, slab_ref, sems):
+        i = pl.program_id(0)
+        buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks,
+                            stack=True)
+        slab = slab_ref[buf]                       # (b, L, Wp)
+        for k in range(g):
+            if topology is Topology.DEAD:
+                slab = _zero_edge_rows(slab, i, n_blocks, g - k, row_axis=1)
+            plist = step_planes_slab(
+                tuple(slab[j] for j in range(b)), rule, topology)
+            slab = jnp.stack(plist)
+        out_ref[:] = slab
+
+    return kernel, n_blocks, L
+
+
+@lru_cache(maxsize=64)
+def _build_gen_runner(rule, topology: Topology, shape, bh: int, g: int,
+                      interpret: bool, donate: bool):
+    b, H, Wp = shape
+    kernel, n_blocks, L = _make_gen_kernel(rule, topology, b, H, Wp, bh, g)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, H, Wp), jnp.uint32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((b, bh, Wp), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, b, L, Wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(
+        lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def multi_step_pallas_generations(
+    planes: jax.Array,
+    n: int,
+    *,
+    rule,
+    topology: Topology = Topology.TORUS,
+    block_rows: Optional[int] = None,
+    gens_per_call: Optional[int] = None,
+    interpret: bool = False,
+    donate: bool = False,
+) -> jax.Array:
+    """``n`` generations of a Generations rule on a (b, H, W/32) bit-plane
+    stack via the temporal-blocked kernel; the n % g remainder takes the
+    XLA bit-plane path. ``n`` is a Python int."""
+    from .packed_generations import multi_step_packed_generations
+
+    b, H, Wp = planes.shape
+    g_req = gens_per_call or DEFAULT_GENS_PER_CALL
+    bh = block_rows or _pick_bh(H, native=not interpret, g=g_req,
+                                Wp=Wp * b)  # b planes share the budget
+    g = min(g_req, bh)
+    if H % bh:
+        raise ValueError(f"grid height {H} not divisible by block rows {bh}")
+    if not interpret and (bh % 8 or g % 8):
+        raise ValueError(
+            f"native TPU kernel needs block_rows ({bh}) and gens_per_call "
+            f"({g}) to be multiples of 8 (sublane tiling)")
+    loop = _build_gen_runner(rule, topology, (b, H, Wp), bh, g, interpret,
+                             donate)
+    chunks, rem = divmod(int(n), g)
+    if chunks:
+        planes = loop(planes, chunks)
+    if rem:
+        planes = multi_step_packed_generations(
+            planes, rem, rule=rule, topology=topology,
+            donate=donate or chunks > 0)
+    return planes
 
 
 @lru_cache(maxsize=64)
@@ -228,7 +330,7 @@ def band_supported(band_rows: int, g: int, *, native: bool,
     return True
 
 
-def supported(shape, *, on_tpu: bool) -> bool:
+def supported(shape, *, on_tpu: bool, planes: int = 1) -> bool:
     """Whether the kernel can run this packed (H, Wp) shape natively.
 
     The TPU lane (last) dimension must be a multiple of 128 words (= 4096
@@ -236,11 +338,13 @@ def supported(shape, *, on_tpu: bool) -> bool:
     block decomposition with 8-aligned DMA offsets exists), and even the
     shortest legal block (8 rows) must fit the double-buffered VMEM budget
     — widths up to ~1.8M cells; interpret mode (CPU) has no constraint.
+    ``planes`` scales the VMEM budget for the Generations bit-plane stack
+    (b planes share one slab buffer); alignment is per plane.
     """
     H, Wp = shape
     return not on_tpu or (
         Wp % 128 == 0 and H % 8 == 0
-        and _vmem_bytes(8, DEFAULT_GENS_PER_CALL, Wp) <= _VMEM_BUDGET)
+        and _vmem_bytes(8, DEFAULT_GENS_PER_CALL, Wp * planes) <= _VMEM_BUDGET)
 
 
 def default_interpret() -> bool:

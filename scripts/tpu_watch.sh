@@ -17,7 +17,7 @@ for i in $(seq 1 60); do
   if grep -q '^healthy' "$PROBE_OUT"; then
     echo "=== healthy at $(date -u +%H:%M:%S), capturing ==="
     timeout 3000 python scripts/tpu_worklist.py --force \
-      --items pallas_identity,pallas_band,bench_packed,ltl_bosco,generations_brain,profile_trace,config5_sparse
+      --items pallas_identity,pallas_band,pallas_generations,bench_packed,ltl_bosco,generations_brain,profile_trace,config5_sparse
     timeout 600 python bench.py --no-probe
     timeout 600 python bench.py --no-probe --size 1024
     timeout 600 python bench.py --no-probe --size 8192

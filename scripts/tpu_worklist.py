@@ -334,6 +334,62 @@ def child_pallas_band() -> dict:
     return out
 
 
+def child_pallas_generations() -> dict:
+    """Native Mosaic validation + rate for the Generations bit-plane
+    kernel (ops/pallas_stencil.py multi_step_pallas_generations): on-chip
+    bit-identity vs the XLA bit-plane path, then the bench-shape rate vs
+    the XLA path under the same long-run protocol."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops.packed_generations import (
+        multi_step_packed_generations,
+        pack_generations_for,
+    )
+    from gameoflifewithactors_tpu.ops.pallas_stencil import (
+        multi_step_pallas_generations,
+    )
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    rule = parse_any("brain")
+    rng = np.random.default_rng(5)
+    out = {"platform": jax.devices()[0].platform, "rule": rule.notation,
+           "cases": []}
+    small = pack_generations_for(jnp.asarray(
+        rng.integers(0, rule.states, size=(512, 4096), dtype=np.uint8)), rule)
+    for topology in (Topology.TORUS, Topology.DEAD):
+        for gens in (8, 23):
+            want = multi_step_packed_generations(small, gens, rule=rule,
+                                                 topology=topology)
+            got = multi_step_pallas_generations(
+                jnp.array(small), gens, rule=rule, topology=topology,
+                interpret=False)
+            same = _device_equal(got, want)
+            out["cases"].append({"topology": topology.value, "gens": gens,
+                                 "bit_identical": same})
+            if not same:
+                out["ok"] = False
+                return out
+
+    side = 16384
+    big = pack_generations_for(jnp.asarray(
+        rng.integers(0, rule.states, size=(side, side), dtype=np.uint8)), rule)
+    runs = {
+        "pallas": lambda s, n: multi_step_pallas_generations(
+            s, int(n), rule=rule, topology=Topology.TORUS, interpret=False,
+            donate=True),
+        "xla_planes": lambda s, n: multi_step_packed_generations(
+            s, n, rule=rule, topology=Topology.TORUS, donate=True),
+    }
+    for name, run in runs.items():
+        out[f"{name}_cell_updates_per_sec"] = _bench_rate(
+            run, jnp.array(big), side, 1024)
+    out["ok"] = True
+    return out
+
+
 def child_profile_trace() -> dict:
     """A real profiler trace of the Pallas kernel (utils/profiling.py):
     records that the trace capture machinery works against the actual
@@ -396,6 +452,7 @@ ITEMS = {
     "generations_brain": child_generations_brain,
     "ltl_lowering": child_ltl_lowering,
     "pallas_band": child_pallas_band,
+    "pallas_generations": child_pallas_generations,
     "profile_trace": child_profile_trace,
     "config5_sparse": child_config5_sparse,
 }

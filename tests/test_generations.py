@@ -113,8 +113,15 @@ def test_engine_rejects_out_of_range_states_and_packed_kernels():
     g = np.full((4, 32), 3, dtype=np.uint8)
     with pytest.raises(ValueError, match="states 0..2"):
         Engine(g, "B2/S/C3")
-    with pytest.raises(ValueError, match="binary-only"):
-        Engine(np.zeros((4, 32), np.uint8), "B2/S/C3", backend="pallas")
+    # pallas + Generations is now a real (single-device) path; sparse and
+    # sharded-pallas remain out of the family's reach
+    with pytest.raises(ValueError, match="sparse is 3x3-binary-only"):
+        Engine(np.zeros((4, 32), np.uint8), "B2/S/C3", backend="sparse")
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    with pytest.raises(ValueError, match="single-device"):
+        Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="pallas",
+               mesh=mesh_lib.make_mesh((2, 4)))
 
 
 def test_generations_checkpoint_roundtrip(tmp_path):
