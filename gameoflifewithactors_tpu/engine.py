@@ -60,19 +60,24 @@ class Engine:
     rule: a Rule or rule string ("B3/S23", "highlife", ...).
     topology: TORUS (wrap) or DEAD (all-dead boundary).
     mesh: optional jax Mesh for 2D sharding; None = single device.
-    backend: "auto" (default: picks "pallas" on a single TPU device for
-        3x3 binary rules at supported shapes, else "packed"), "packed"
-        (32 cells/word SWAR fast path), "dense"
+    backend: "auto" (default: the fastest correct path — on TPU that is
+        the "pallas" kernel for 3x3 binary rules single-device and on
+        TORUS (nx, 1) row-band meshes at supported shapes, "packed"
+        otherwise), "packed" (32 cells/word SWAR fast path), "dense"
         (1 byte/cell, debug path), "pallas" (temporal-blocked Mosaic
-        kernel advancing several generations per HBM round-trip;
-        single-device only — the sharded engines use the packed path), or
-        "sparse" (activity-tiled: compute scales with changed area, for
-        huge mostly-empty universes; both topologies on one device —
-        torus refreshes the halo ring with wrapped edges each generation
-        — and with a mesh it shards with per-device activity skipping).
-    gens_per_exchange: sharded packed backend only — G > 1 exchanges a
-        depth-G halo once per G generations (communication-avoiding;
-        bit-exact for G <= 32) instead of a 1-deep halo every generation.
+        kernel advancing several generations per HBM round-trip; serves
+        3x3 binary rules single-device and on (nx, 1) TORUS meshes, and
+        Generations rules single-device and on (nx, 1) TORUS meshes via
+        the bit-plane kernel), or "sparse" (activity-tiled, 3x3 binary:
+        compute scales with changed area, for huge mostly-empty
+        universes; both topologies on one device — torus refreshes the
+        halo ring with wrapped edges each generation — and with a mesh
+        it shards with per-device activity skipping).
+    gens_per_exchange: sharded packed and pallas backends — G > 1
+        exchanges a depth-G halo once per G generations
+        (communication-avoiding) instead of a 1-deep halo every
+        generation; bit-exact for G <= 32 on the packed 2D-tile runner,
+        uncapped on the pallas row-band runners.
     """
 
     def __init__(
@@ -107,23 +112,22 @@ class Engine:
             raise ValueError(
                 f"gens_per_exchange must be >= 1, got {gens_per_exchange}")
         if gens_per_exchange != 1 and not (
-                mesh is not None and backend in ("packed", "pallas")
-                and not (self._generations or self._ltl)):
+                mesh is not None
+                and ((backend in ("packed", "pallas")
+                      and not (self._generations or self._ltl))
+                     or (backend == "pallas" and self._generations))):
             raise ValueError(
                 "gens_per_exchange applies to the sharded packed and pallas "
-                "backends only (mesh + backend='packed'/'pallas'/'auto', "
-                "3x3 binary rule)")
+                "backends only (mesh + backend='packed'/'pallas'/'auto' for "
+                "3x3 binary rules, mesh + backend='pallas' for Generations)")
         if ((self._generations and backend == "sparse")
-                or (self._ltl and backend in ("pallas", "sparse"))
-                or (self._generations and backend == "pallas"
-                    and mesh is not None)):
+                or (self._ltl and backend in ("pallas", "sparse"))):
             raise ValueError(
                 f"backend={backend!r} does not serve "
-                f"{type(self.rule).__name__} rules ({self.rule.notation}) "
-                "in this configuration: sparse is 3x3-binary-only, LtL has "
-                "no pallas kernel, and the Generations pallas kernel is "
-                "single-device (backend='packed' is the bit-plane stack / "
-                "bit-sliced bitboard; backend='dense' the byte layout)"
+                f"{type(self.rule).__name__} rules ({self.rule.notation}): "
+                "sparse is 3x3-binary-only and LtL has no pallas kernel "
+                "(backend='packed' is the bit-plane stack / bit-sliced "
+                "bitboard; backend='dense' the byte layout)"
             )
         self.topology = topology
         self.mesh = mesh
@@ -170,6 +174,14 @@ class Engine:
                             and backend in ("packed", "pallas") and _packs)
         if (self._generations and backend in ("packed", "pallas")
                 and not self._gen_packed):
+            if gens_per_exchange != 1:
+                # the dense fallback has no communication-avoiding runner:
+                # dropping the requested exchange depth silently would be a
+                # contract violation, so mirror the binary path's hard error
+                raise ValueError(
+                    f"gens_per_exchange={gens_per_exchange} needs the "
+                    f"bit-plane band runner, but width {self.shape[1]} does "
+                    f"not pack into 32-cell words over {_ny} mesh column(s)")
             # same honesty as the LtL fallback: report the byte path that
             # actually runs, warn only on explicit requests
             if explicit_packed or backend == "pallas":
@@ -218,7 +230,20 @@ class Engine:
                     self._run = sharded.make_multi_step_ltl(
                         mesh, self.rule, topology, donate=True)
             elif self._generations:
-                if self._gen_packed:
+                if self._gen_packed and backend == "pallas":
+                    # row-band native kernel over the plane stack; n % g
+                    # remainders take the per-gen sharded plane runner
+                    g = (gens_per_exchange if gens_per_exchange > 1
+                         else pallas_stencil.DEFAULT_GENS_PER_CALL)
+                    self.gens_per_exchange = g
+                    self._run = _chunked(
+                        sharded.make_multi_step_generations_pallas(
+                            mesh, self.rule, topology, gens_per_exchange=g,
+                            donate=True),
+                        sharded.make_multi_step_generations_packed(
+                            mesh, self.rule, topology, donate=True),
+                        g)
+                elif self._gen_packed:
                     self._run = sharded.make_multi_step_generations_packed(
                         mesh, self.rule, topology, donate=True)
                 else:

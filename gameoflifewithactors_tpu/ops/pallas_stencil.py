@@ -162,12 +162,15 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
 
 
 def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
-                     bh: int, g: int):
+                     bh: int, g: int, slab_mode: bool = False):
     """Temporal-blocked kernel for the Generations bit-plane stack: the
     (b, H, Wp) planes ride the same 3-segment double-buffered DMA scheme
     (leading plane axis copied whole per segment), the in-VMEM loop steps
     packed_generations.step_planes_slab, and DEAD re-zeroes the exterior
     rows of boundary blocks every generation exactly like the binary form.
+    ``slab_mode`` has the same two closure modes as _make_kernel: the H
+    rows are a halo-extended row band, out-of-range DMA payloads are
+    zeroed once, and no per-generation re-zero happens.
     """
     from .packed_generations import step_planes_slab
 
@@ -180,7 +183,10 @@ def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
                             stack=True)
         slab = slab_ref[buf]                       # (b, L, Wp)
         for k in range(g):
-            if topology is Topology.DEAD:
+            if slab_mode:
+                if k == 0:
+                    slab = _zero_edge_rows(slab, i, n_blocks, g, row_axis=1)
+            elif topology is Topology.DEAD:
                 slab = _zero_edge_rows(slab, i, n_blocks, g - k, row_axis=1)
             plist = step_planes_slab(
                 tuple(slab[j] for j in range(b)), rule, topology)
@@ -190,12 +196,31 @@ def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
     return kernel, n_blocks, L
 
 
-@lru_cache(maxsize=64)
-def _build_gen_runner(rule, topology: Topology, shape, bh: int, g: int,
-                      interpret: bool, donate: bool):
+def _validate_slab(He: int, bh: int, g: int, interpret: bool) -> None:
+    """Shared slab-kernel shape guards (binary and Generations forms)."""
+    if He % bh:
+        raise ValueError(
+            f"extended height {He} not divisible by block rows {bh}")
+    if g > bh:
+        # the 3-segment DMA scheme needs the g rows above/below a block to
+        # be contiguous in the previous/next block: g <= bh. Violations are
+        # NOT caught downstream — interior blocks assemble wrong neighbor
+        # rows (clamped offsets in interpret mode, out-of-range DMAs native)
+        raise ValueError(
+            f"slab kernel needs gens ({g}) <= block_rows ({bh}); pick a "
+            f"larger block_rows or a shallower exchange depth")
+    if not interpret and (bh % 8 or g % 8):
+        raise ValueError(
+            f"native TPU slab kernel needs block_rows ({bh}) and gens ({g}) "
+            f"to be multiples of 8 (sublane tiling)")
+
+
+def _gen_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
+                     interpret: bool, slab_mode: bool):
     b, H, Wp = shape
-    kernel, n_blocks, L = _make_gen_kernel(rule, topology, b, H, Wp, bh, g)
-    call = pl.pallas_call(
+    kernel, n_blocks, L = _make_gen_kernel(rule, topology, b, H, Wp, bh, g,
+                                           slab_mode=slab_mode)
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, H, Wp), jnp.uint32),
         grid=(n_blocks,),
@@ -208,10 +233,41 @@ def _build_gen_runner(rule, topology: Topology, shape, bh: int, g: int,
         ],
         interpret=interpret,
     )
+
+
+@lru_cache(maxsize=64)
+def _build_gen_runner(rule, topology: Topology, shape, bh: int, g: int,
+                      interpret: bool, donate: bool):
+    call = _gen_pallas_call(rule, topology, shape, bh, g, interpret,
+                            slab_mode=False)
     return jax.jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
         donate_argnums=(0,) if donate else (),
     )
+
+
+@lru_cache(maxsize=64)
+def make_pallas_gen_slab_step(
+    rule,
+    topology: Topology,
+    ext_shape,
+    *,
+    gens: int,
+    block_rows: Optional[int] = None,
+    interpret: bool = False,
+):
+    """``ext (b, He, Wp) -> (b, He, Wp)`` advancing ``gens`` generations of
+    a halo-extended full-width Generations row band (He = band + 2*gens);
+    the caller crops ``out[:, gens:-gens]``. Same contract as
+    :func:`make_pallas_slab_step`, plane-stack form; shard_map callers
+    need ``check_vma=False``."""
+    b, He, Wp = ext_shape
+    g = int(gens)
+    bh = block_rows or _pick_bh(He, native=not interpret, at_least=g, g=g,
+                                Wp=Wp * b)
+    _validate_slab(He, bh, g, interpret)
+    return _gen_pallas_call(rule, topology, (b, He, Wp), bh, g, interpret,
+                            slab_mode=True)
 
 
 def multi_step_pallas_generations(
@@ -292,20 +348,7 @@ def make_pallas_slab_step(
     g = int(gens)
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=g,
                                 g=g, Wp=Wp)
-    if He % bh:
-        raise ValueError(f"extended height {He} not divisible by block rows {bh}")
-    if g > bh:
-        # the 3-segment DMA scheme needs the g rows above/below a block to
-        # be contiguous in the previous/next block: g <= bh. Violations are
-        # NOT caught downstream — interior blocks assemble wrong neighbor
-        # rows (clamped offsets in interpret mode, out-of-range DMAs native)
-        raise ValueError(
-            f"slab kernel needs gens ({g}) <= block_rows ({bh}); pick a "
-            f"larger block_rows or a shallower exchange depth")
-    if not interpret and (bh % 8 or g % 8):
-        raise ValueError(
-            f"native TPU slab kernel needs block_rows ({bh}) and gens ({g}) "
-            f"to be multiples of 8 (sublane tiling)")
+    _validate_slab(He, bh, g, interpret)
     return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret)
 
 

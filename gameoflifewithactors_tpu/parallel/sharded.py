@@ -28,7 +28,13 @@ from ..models.rules import Rule
 from ..ops import packed as packed_ops
 from ..ops import stencil as stencil_ops
 from ..ops.stencil import Topology
-from .halo import exchange_cols, exchange_halo, exchange_halo_stack, exchange_rows
+from .halo import (
+    exchange_cols,
+    exchange_halo,
+    exchange_halo_stack,
+    exchange_rows,
+    exchange_rows_stack,
+)
 from .mesh import COL_AXIS, ROW_AXIS
 
 _SPEC = P(ROW_AXIS, COL_AXIS)
@@ -281,6 +287,60 @@ def make_multi_step_pallas(
              out_specs=band_spec, check_vma=False)
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step_generations_pallas(
+    mesh: Mesh,
+    rule,
+    topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+    donate: bool = False,
+) -> Callable:
+    """Row-band sharding over the Generations bit-plane kernel: the
+    multi-state twin of :func:`make_multi_step_pallas` (same (nx, 1)
+    TORUS-only contract, same depth-g exchange/crop scheme — see that
+    docstring for the rationale), with ONE stacked ppermute per side per
+    chunk carrying all b planes (halo.exchange_rows_stack). Returns jitted
+    ``(planes, chunks) -> planes`` on a (b, H, W/32) stack sharded
+    P(None, 'x', None), advancing ``chunks * g`` generations."""
+    from ..ops.pallas_stencil import default_interpret, make_pallas_gen_slab_step
+
+    if topology is not Topology.TORUS:
+        raise ValueError(
+            "make_multi_step_generations_pallas supports TORUS only (see "
+            "make_multi_step_pallas); use make_multi_step_generations_packed")
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if ny != 1:
+        raise ValueError(
+            f"make_multi_step_generations_pallas needs an (nx, 1) row-band "
+            f"mesh (got ny={ny}); use make_multi_step_generations_packed")
+    g = int(gens_per_exchange)
+    if interpret is None:
+        interpret = default_interpret()
+
+    spec3 = P(None, ROW_AXIS, None)
+
+    def chunk(planes):
+        if g > planes.shape[1]:  # static shapes: caught at trace time
+            raise ValueError(
+                f"gens_per_exchange={g} exceeds the per-device band height "
+                f"{planes.shape[1]}")
+        ext = exchange_rows_stack(planes, nx, topology, depth=g)
+        call = make_pallas_gen_slab_step(
+            rule, topology, ext.shape, gens=g, block_rows=block_rows,
+            interpret=interpret)
+        return call(ext)[:, g:-g]
+
+    # check_vma=False: same scratch-DMA typing limitation as the binary
+    # band runner
+    @partial(shard_map, mesh=mesh, in_specs=(spec3, P()), out_specs=spec3,
+             check_vma=False)
+    def _run(planes, chunks):
+        return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), planes)
 
     return jax.jit(_run, donate_argnums=(0,) if donate else ())
 

@@ -113,13 +113,13 @@ def test_engine_rejects_out_of_range_states_and_packed_kernels():
     g = np.full((4, 32), 3, dtype=np.uint8)
     with pytest.raises(ValueError, match="states 0..2"):
         Engine(g, "B2/S/C3")
-    # pallas + Generations is now a real (single-device) path; sparse and
-    # sharded-pallas remain out of the family's reach
+    # pallas + Generations is a real path (single-device and (nx, 1) row
+    # bands); sparse and 2D-tile pallas remain out of the family's reach
     with pytest.raises(ValueError, match="sparse is 3x3-binary-only"):
         Engine(np.zeros((4, 32), np.uint8), "B2/S/C3", backend="sparse")
     from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
 
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
         Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="pallas",
                mesh=mesh_lib.make_mesh((2, 4)))
 
@@ -186,3 +186,14 @@ def test_set_grid_validates_states():
     e = Engine(np.zeros((8, 32), np.uint8), "B2/S/C3")
     with pytest.raises(ValueError, match="states 0..2"):
         e.set_grid(np.full((8, 32), 7, np.uint8))
+
+
+def test_gen_band_gens_per_exchange_needs_packing_width():
+    """A requested exchange depth must not be silently dropped when the
+    width can't pack into the bit-plane band runner (review contract)."""
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh((2, 1), jax.devices()[:2])
+    with pytest.raises(ValueError, match="does not pack"):
+        Engine(np.zeros((16, 48), np.uint8), "B2/S/C3", backend="pallas",
+               mesh=m, gens_per_exchange=8)
