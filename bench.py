@@ -145,6 +145,7 @@ def run_bench(args) -> None:
             f"{rule.notation} is a 1D (elementary) rule; this bench times 2D "
             "grids. Drive ops.elementary directly (see examples/wolfram.py)")
     explicitly_packed = args.backend == "packed"
+    explicitly_pallas = args.backend == "pallas"
     if args.backend == "auto":
         # pallas (temporal-blocked Mosaic kernel, ~2.8x the XLA SWAR rate on
         # chip) when native and the shape qualifies; XLA packed elsewhere
@@ -164,7 +165,20 @@ def run_bench(args) -> None:
                 f"path; --backend {args.backend} -> {target}\n")
         args.backend = target
 
-    if isinstance(rule, GenRule) and args.backend != "dense":
+    if isinstance(rule, GenRule) and args.backend == "pallas":
+        # the Generations bit-plane kernel is honored only on EXPLICIT
+        # request at shapes it supports (auto stays on the measured packed
+        # path until the pallas_generations worklist item proves otherwise);
+        # the supported() gate budgets VMEM for all b planes, like engine.py
+        from gameoflifewithactors_tpu.ops.packed_generations import n_planes
+        from gameoflifewithactors_tpu.ops.pallas_stencil import supported
+
+        ok = (explicitly_pallas and side % 32 == 0
+              and supported((side, side // 32), on_tpu=platform == "tpu",
+                            planes=n_planes(rule.states)))
+        if not ok:
+            _route_rule(True, "bit-plane packed")
+    elif isinstance(rule, GenRule) and args.backend != "dense":
         # multi-state rules have a bit-plane packed path (~4x the dense
         # rate on CPU) when the width packs (32 cells/word)
         _route_rule(True, "bit-plane packed")
@@ -194,7 +208,20 @@ def run_bench(args) -> None:
         grid = rng.integers(0, rule.states, size=(side, side), dtype=np.uint8)
     else:
         grid = rng.integers(0, 2, size=(side, side), dtype=np.uint8)
-    if isinstance(rule, GenRule) and args.backend == "packed":
+    if isinstance(rule, GenRule) and args.backend == "pallas":
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            pack_generations_for,
+        )
+        from gameoflifewithactors_tpu.ops.pallas_stencil import (
+            multi_step_pallas_generations,
+        )
+
+        state = pack_generations_for(jnp.asarray(grid), rule)
+        interpret = default_interpret()
+        run = lambda s, n: multi_step_pallas_generations(
+            s, int(n), rule=rule, topology=Topology.TORUS,
+            interpret=interpret, donate=True)
+    elif isinstance(rule, GenRule) and args.backend == "packed":
         from gameoflifewithactors_tpu.ops.packed_generations import (
             multi_step_packed_generations,
             pack_generations_for,
