@@ -33,7 +33,7 @@ class SimulationConfig:
     gens_per_exchange: int = 1              # sharded packed: halo depth G, exchange every G gens
     sparse_tile: Optional[Tuple[int, int]] = None   # (rows, cols), cols % 32 == 0
     sparse_capacity: Optional[int] = None   # max active tiles before dense fallback
-    mesh: Optional[str] = None              # None | "auto" | "2x4"
+    mesh: Optional[str] = None              # None | "auto" | "bands" | "2x4"
     steps: int = 100
     render_every: int = 1
     view_height: int = 40
@@ -53,11 +53,19 @@ class SimulationConfig:
             return None
         if self.mesh == "auto":
             return mesh_lib.make_mesh()
+        if self.mesh == "bands":
+            # (n, 1) row bands: the layout the native pallas runners need
+            # (full-width bands; backend 'auto' then picks the kernel on
+            # TPU for eligible rules/shapes)
+            import jax
+
+            return mesh_lib.make_mesh((len(jax.devices()), 1))
         try:
             shape = _parse_geometry(self.mesh)
         except argparse.ArgumentTypeError:
             raise ValueError(
-                f"--mesh must be 'auto' or like '2x4', got {self.mesh!r}"
+                f"--mesh must be 'auto', 'bands', or like '2x4', "
+                f"got {self.mesh!r}"
             ) from None
         return mesh_lib.make_mesh(shape)
 
@@ -173,7 +181,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--sparse-capacity", type=int, default=None, metavar="N",
                    help="sparse backend: max active tiles per step before dense fallback")
     p.add_argument("--mesh", default=None,
-                   help="'auto' (all devices) or 'NXxNY'; default single-device")
+                   help="'auto' (all devices, 2D tiles), 'bands' (all "
+                        "devices as (N, 1) full-width row bands — the "
+                        "layout the native pallas runners use), or "
+                        "'NXxNY'; default single-device")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--render", choices=["off", "live", "final"], default="off")
     p.add_argument("--render-every", type=int, default=1, metavar="N",

@@ -166,3 +166,16 @@ def test_unpack_np_roundtrip():
     rng = np.random.default_rng(8)
     g = rng.integers(0, 2, size=(33, 128), dtype=np.uint8)
     np.testing.assert_array_equal(bitpack.unpack_np(bitpack.pack_np(g)), g)
+
+
+def test_cli_mesh_bands_end_to_end(capsys):
+    """--mesh bands builds an (n, 1) row-band mesh and runs end-to-end."""
+    from gameoflifewithactors_tpu.cli import main as cli_main
+    from gameoflifewithactors_tpu.config import SimulationConfig
+
+    m = SimulationConfig(height=64, width=64, mesh="bands").build_mesh()
+    assert tuple(m.devices.shape) == (8, 1)
+    rc = cli_main(["--grid", "64x64", "--seed", "glider", "--steps", "4",
+                   "--mesh", "bands", "--render", "final", "--population"])
+    assert rc == 0
+    assert "gen 4" in capsys.readouterr().out
