@@ -68,11 +68,12 @@ class Engine:
         kernel advancing several generations per HBM round-trip; serves
         3x3 binary rules single-device and on (nx, 1) TORUS meshes, and
         Generations rules single-device and on (nx, 1) TORUS meshes via
-        the bit-plane kernel), or "sparse" (activity-tiled, 3x3 binary:
-        compute scales with changed area, for huge mostly-empty
-        universes; both topologies on one device — torus refreshes the
-        halo ring with wrapped edges each generation — and with a mesh
-        it shards with per-device activity skipping).
+        the bit-plane kernel), or "sparse" (activity-tiled: compute
+        scales with changed area, for huge mostly-empty universes;
+        3x3 binary bitboards and, single-device, Generations bit-plane
+        stacks; both topologies on one device — torus refreshes the halo
+        ring with wrapped edges each generation — and with a mesh the
+        binary form shards with per-device activity skipping).
     gens_per_exchange: sharded packed and pallas backends — G > 1
         exchanges a depth-G halo once per G generations
         (communication-avoiding) instead of a 1-deep halo every
@@ -120,12 +121,13 @@ class Engine:
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
                 "3x3 binary rules, mesh + backend='pallas' for Generations)")
-        if ((self._generations and backend == "sparse")
+        if ((self._generations and backend == "sparse" and mesh is not None)
                 or (self._ltl and backend in ("pallas", "sparse"))):
             raise ValueError(
                 f"backend={backend!r} does not serve "
-                f"{type(self.rule).__name__} rules ({self.rule.notation}): "
-                "sparse is 3x3-binary-only and LtL has no pallas kernel "
+                f"{type(self.rule).__name__} rules ({self.rule.notation}) "
+                "in this configuration: sharded sparse is 3x3-binary-only "
+                "and LtL has neither a pallas kernel nor a sparse engine "
                 "(backend='packed' is the bit-plane stack / bit-sliced "
                 "bitboard; backend='dense' the byte layout)"
             )
@@ -171,7 +173,15 @@ class Engine:
         # (ops/packed_generations.py), ~4x less HBM traffic than the byte
         # layout; shards as P(None, x, y) with per-plane halo exchange
         self._gen_packed = (self._generations
-                            and backend in ("packed", "pallas") and _packs)
+                            and backend in ("packed", "pallas", "sparse")
+                            and _packs)
+        if self._generations and backend == "sparse" and not self._gen_packed:
+            # the sparse engine's Generations layout IS the plane stack;
+            # there is no byte-layout sparse path to fall back to
+            raise ValueError(
+                f"the sparse backend stores Generations universes as "
+                f"bit-plane stacks: width {self.shape[1]} must be divisible "
+                f"by 32")
         if (self._generations and backend in ("packed", "pallas")
                 and not self._gen_packed):
             if gens_per_exchange != 1:

@@ -40,6 +40,23 @@ from ..models.rules import Rule
 from .packed import step_packed_ext
 from .stencil import Topology
 
+
+def _step_window(window, rule):
+    """One generation of a halo-extended window in either layout: a
+    (tr+2, tw+2) packed bitboard (binary rules) or a (b, tr+2, tw+2)
+    Generations bit-plane stack (leading plane axis)."""
+    if window.ndim == 2:
+        return step_packed_ext(window, rule)
+    from .packed_generations import step_planes_ext
+
+    return jnp.stack(step_planes_ext(
+        tuple(window[i] for i in range(window.shape[0])), rule))
+
+
+def _pad_ring(packed):
+    """One-row/one-word zero ring around the SPATIAL dims only."""
+    return jnp.pad(packed, [(0, 0)] * (packed.ndim - 2) + [(1, 1), (1, 1)])
+
 DEFAULT_TILE_ROWS = 32
 DEFAULT_TILE_WORDS = 4
 _MAX_ADAPTIVE_CAPACITY = 4096
@@ -86,11 +103,11 @@ def _tile_grid_shape(H: int, Wp: int, tile_rows: int, tile_words: int) -> Tuple[
 
 def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int) -> jax.Array:
     """All tiles containing any live cell are initially 'changed'."""
-    interior = padded[1:-1, 1:-1]
-    H, Wp = interior.shape
+    interior = padded[..., 1:-1, 1:-1]
+    H, Wp = interior.shape[-2:]
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
-    tiles = interior.reshape(nty, tile_rows, ntx, tile_words)
-    return (tiles != 0).any(axis=(1, 3))
+    tiles = interior.reshape(*interior.shape[:-2], nty, tile_rows, ntx, tile_words)
+    return (tiles != 0).any(axis=tuple(range(interior.ndim - 2)) + (-3, -1))
 
 
 def _dilate(active: jax.Array, wrap: bool = False) -> jax.Array:
@@ -113,13 +130,14 @@ def _refresh_ring(padded: jax.Array) -> jax.Array:
     """Torus: the one-word/one-row ring holds wrapped copies of the opposite
     interior edges (incl. corners), refreshed every generation so edge tiles
     see current cross-seam neighbors. O(H + Wp) words per generation."""
-    inter = padded[1:-1, 1:-1]
-    padded = padded.at[0, 1:-1].set(inter[-1])
-    padded = padded.at[-1, 1:-1].set(inter[0])
-    padded = padded.at[1:-1, 0].set(inter[:, -1])
-    padded = padded.at[1:-1, -1].set(inter[:, 0])
-    corners = jnp.stack([inter[-1, -1], inter[-1, 0], inter[0, -1], inter[0, 0]])
-    return padded.at[(0, 0, -1, -1), (0, -1, 0, -1)].set(corners)
+    inter = padded[..., 1:-1, 1:-1]
+    padded = padded.at[..., 0, 1:-1].set(inter[..., -1, :])
+    padded = padded.at[..., -1, 1:-1].set(inter[..., 0, :])
+    padded = padded.at[..., 1:-1, 0].set(inter[..., :, -1])
+    padded = padded.at[..., 1:-1, -1].set(inter[..., :, 0])
+    corners = jnp.stack([inter[..., -1, -1], inter[..., -1, 0],
+                         inter[..., 0, -1], inter[..., 0, 0]], axis=-1)
+    return padded.at[..., (0, 0, -1, -1), (0, -1, 0, -1)].set(corners)
 
 
 @lru_cache(maxsize=32)
@@ -147,15 +165,22 @@ def _build_sparse_step(
     aliasing and paid a full-buffer copy every generation (measured
     45 ms/gen vs 3 ms/gen at 32768² on CPU; VERDICT.md round-1 Weak #6).
     """
-    H, Wp = shape
+    lead, (H, Wp) = shape[:-2], shape[-2:]
+    if len(lead) > 1:
+        # the batched scatter below hardcodes ONE leading plane axis
+        # (padded.at[:, rows, cols]); a deeper stack would silently apply
+        # the spatial indices to the wrong axes
+        raise ValueError(f"at most one leading plane axis, got shape {shape}")
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
 
     def gather_window(padded, ty, tx):
         # window = tile + 1 halo ring; padded grid offset makes this exact
+        # (leading plane axes, if any, are taken whole)
         return jax.lax.dynamic_slice(
-            padded, (ty * tile_rows, tx * tile_words),
-            (tile_rows + 2, tile_words + 2),
+            padded,
+            (0,) * len(lead) + (ty * tile_rows, tx * tile_words),
+            lead + (tile_rows + 2, tile_words + 2),
         )
 
     def sparse_gen(padded, candidates, n_cand):
@@ -165,9 +190,10 @@ def _build_sparse_step(
         valid = jnp.arange(capacity) < n_cand
         tys, txs = idx // ntx, idx % ntx
         windows = jax.vmap(lambda ty, tx: gather_window(padded, ty, tx))(tys, txs)
-        stepped = jax.vmap(lambda w: step_packed_ext(w, rule))(windows)
-        olds = windows[:, 1:-1, 1:-1]
-        changed_any = jnp.logical_and((stepped != olds).any(axis=(1, 2)), valid)
+        stepped = jax.vmap(lambda w: _step_window(w, rule))(windows)
+        olds = windows[..., 1:-1, 1:-1]
+        changed_any = jnp.logical_and(
+            (stepped != olds).any(axis=tuple(range(1, stepped.ndim))), valid)
 
         # ONE batched scatter for all tiles (vs. a capacity-long serial
         # chain of dynamic_update_slice). Invalid (fill) slots alias tile 0
@@ -178,8 +204,14 @@ def _build_sparse_step(
         col0 = jnp.where(valid, txs * tile_words + 1, Wp + 2)
         rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
         cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
-        padded = padded.at[rows, cols].set(stepped, mode="drop",
-                                           unique_indices=True)
+        if lead:
+            # (K, b, tr, tw) -> (b, K, tr, tw): the spatial scatter is the
+            # same for every plane of the stack
+            padded = padded.at[:, rows, cols].set(
+                jnp.moveaxis(stepped, 1, 0), mode="drop", unique_indices=True)
+        else:
+            padded = padded.at[rows, cols].set(stepped, mode="drop",
+                                               unique_indices=True)
         active = jnp.zeros((nty, ntx), dtype=bool)
         active = active.at[jnp.where(valid, tys, nty),
                            jnp.where(valid, txs, ntx)].set(
@@ -223,7 +255,7 @@ def _build_dense_once(
     """One full-grid generation (the overflow fallback). Deliberately NOT
     keyed on capacity: an adaptive engine that escalates must not
     re-compile this O(grid) step per capacity level."""
-    H, Wp = shape
+    lead, (H, Wp) = shape[:-2], shape[-2:]
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
 
@@ -231,14 +263,16 @@ def _build_dense_once(
     def dense_once(padded):
         if wrap:
             padded = _refresh_ring(padded)
-        old = padded[1:-1, 1:-1]
+        old = padded[..., 1:-1, 1:-1]
         # step the interior against the ring (zero = DEAD boundary;
         # wrapped copies = torus)
-        new = step_packed_ext(padded, rule)
-        tiles_old = old.reshape(nty, tile_rows, ntx, tile_words)
-        tiles_new = new.reshape(nty, tile_rows, ntx, tile_words)
-        changed = (tiles_old != tiles_new).any(axis=(1, 3))
-        padded = jax.lax.dynamic_update_slice(padded, new, (1, 1))
+        new = _step_window(padded, rule)
+        tiles_old = old.reshape(*lead, nty, tile_rows, ntx, tile_words)
+        tiles_new = new.reshape(*lead, nty, tile_rows, ntx, tile_words)
+        changed = (tiles_old != tiles_new).any(
+            axis=tuple(range(len(lead))) + (-3, -1))
+        padded = jax.lax.dynamic_update_slice(
+            padded, new, (0,) * len(lead) + (1, 1))
         return padded, changed
 
     return dense_once
@@ -257,7 +291,7 @@ class SparseEngineState:
         capacity: int | None = None,
         topology: Topology = Topology.DEAD,
     ):
-        H, Wp = packed.shape
+        H, Wp = packed.shape[-2:]
         if tile_rows is None and tile_words is None:
             tile_rows, tile_words = auto_tile(H, Wp)
         tile_rows = tile_rows or DEFAULT_TILE_ROWS
@@ -280,8 +314,8 @@ class SparseEngineState:
         self.tile_rows = tile_rows
         self.tile_words = tile_words
         self.topology = topology
-        self.shape = (H, Wp)
-        self.padded = jnp.pad(packed, 1)
+        self.shape = tuple(packed.shape)
+        self.padded = _pad_ring(packed)
         self.active = initial_activity(self.padded, tile_rows, tile_words)
         nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
         self._cap_ceiling = min(_MAX_ADAPTIVE_CAPACITY,
@@ -350,7 +384,7 @@ class SparseEngineState:
 
     @property
     def packed(self) -> jax.Array:
-        return self.padded[1:-1, 1:-1]
+        return self.padded[..., 1:-1, 1:-1]
 
     def active_tiles(self) -> int:
         return int(jnp.sum(self.active))

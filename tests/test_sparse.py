@@ -357,3 +357,75 @@ def test_explicit_capacity_stays_fixed():
         p, 12, rule=CONWAY, topology=Topology.DEAD))
     np.testing.assert_array_equal(
         np.asarray(bitpack.unpack(s.packed)), np.asarray(want))
+
+
+class TestSparseGenerations:
+    """Activity-tiled stepping for the multi-state family: the (b, H, W/32)
+    plane stack rides the same gather/step/scatter machinery (leading plane
+    axis carried whole), decaying regions stay awake until quiescent."""
+
+    @staticmethod
+    def _blob(topology):
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            pack_generations_for,
+        )
+
+        rule = parse_any("brain")
+        rng = np.random.default_rng(3)
+        grid = np.zeros((256, 256), np.uint8)
+        grid[100:110, 100:110] = rng.integers(0, 3, size=(10, 10))
+        return rule, pack_generations_for(jnp.asarray(grid), rule)
+
+    @pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+    def test_bit_identity_vs_plane_stepper(self, topology):
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            multi_step_packed_generations,
+        )
+
+        rule, planes = self._blob(topology)
+        want = multi_step_packed_generations(jnp.array(planes), 40, rule=rule,
+                                             topology=topology)
+        s = SparseEngineState(jnp.array(planes), rule, topology=topology)
+        s.step(40)
+        np.testing.assert_array_equal(np.asarray(s.packed), np.asarray(want))
+        # 4 awake tiles out of 64: the decayed field went back to sleep
+        assert s.active_tiles() < 8
+
+    def test_overflow_dense_fallback_and_adaptive(self):
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            multi_step_packed_generations,
+        )
+
+        rule, planes = self._blob(Topology.DEAD)
+        want = multi_step_packed_generations(jnp.array(planes), 25, rule=rule,
+                                             topology=Topology.DEAD)
+        fixed = SparseEngineState(jnp.array(planes), rule, capacity=2,
+                                  tile_rows=16, tile_words=2)
+        fixed.step(25)
+        np.testing.assert_array_equal(np.asarray(fixed.packed), np.asarray(want))
+        adaptive = SparseEngineState(jnp.array(planes), rule,
+                                     tile_rows=16, tile_words=2)
+        adaptive.step(25)
+        np.testing.assert_array_equal(np.asarray(adaptive.packed),
+                                      np.asarray(want))
+
+    def test_engine_facade_and_rejections(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        grid = np.zeros((64, 64), np.uint8)
+        grid[30:34, 30:34] = 2
+        ref = Engine(grid, "brain")
+        sp = Engine(grid, "brain", backend="sparse")
+        ref.step(12)
+        sp.step(12)
+        np.testing.assert_array_equal(ref.snapshot(), sp.snapshot())
+        assert sp.population() == ref.population()
+        with pytest.raises(ValueError, match="divisible by 32"):
+            Engine(np.zeros((16, 48), np.uint8), "brain", backend="sparse")
+        with pytest.raises(ValueError, match="sharded sparse is 3x3-binary"):
+            Engine(np.zeros((16, 256), np.uint8), "brain", backend="sparse",
+                   mesh=mesh_lib.make_mesh((2, 4)))
+        with pytest.raises(ValueError, match="neither a pallas kernel nor"):
+            Engine(np.zeros((16, 32), np.uint8), "bosco", backend="sparse")
