@@ -121,14 +121,11 @@ class Engine:
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
                 "3x3 binary rules, mesh + backend='pallas' for Generations)")
-        if ((self._generations and backend == "sparse" and mesh is not None)
-                or (self._ltl and backend in ("pallas", "sparse"))):
+        if self._ltl and backend in ("pallas", "sparse"):
             raise ValueError(
-                f"backend={backend!r} does not serve "
-                f"{type(self.rule).__name__} rules ({self.rule.notation}) "
-                "in this configuration: sharded sparse is 3x3-binary-only "
-                "and LtL has neither a pallas kernel nor a sparse engine "
-                "(backend='packed' is the bit-plane stack / bit-sliced "
+                f"backend={backend!r} does not serve LtLRule rules "
+                f"({self.rule.notation}): LtL has neither a pallas kernel "
+                "nor a sparse engine (backend='packed' is the bit-sliced "
                 "bitboard; backend='dense' the byte layout)"
             )
         self.topology = topology
@@ -180,8 +177,9 @@ class Engine:
             # there is no byte-layout sparse path to fall back to
             raise ValueError(
                 f"the sparse backend stores Generations universes as "
-                f"bit-plane stacks: width {self.shape[1]} must be divisible "
-                f"by 32")
+                f"bit-plane stacks: width {self.shape[1]} must shard into "
+                f"whole 32-cell words over {_ny} mesh column(s) "
+                f"(divisible by {32 * _ny})")
         if (self._generations and backend in ("packed", "pallas")
                 and not self._gen_packed):
             if gens_per_exchange != 1:
@@ -225,6 +223,14 @@ class Engine:
             state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
+            if backend == "sparse" and sparse_opts:
+                warnings.warn(
+                    "sparse_opts (tile_rows/tile_words/capacity) apply to "
+                    "the single-device sparse engine only; the sharded "
+                    "sparse path skips at per-device granularity and "
+                    "ignores them",
+                    stacklevel=3,
+                )
             if self._ltl:
                 r = self.rule.radius
                 if self.shape[0] // nx < r or self.shape[1] // ny < r:
@@ -240,7 +246,11 @@ class Engine:
                     self._run = sharded.make_multi_step_ltl(
                         mesh, self.rule, topology, donate=True)
             elif self._generations:
-                if self._gen_packed and backend == "pallas":
+                if backend == "sparse":
+                    self._run = self._flagged_sparse_runner(
+                        sharded.make_multi_step_generations_packed_sparse(
+                            mesh, self.rule, topology, donate=True), mesh)
+                elif self._gen_packed and backend == "pallas":
                     # row-band native kernel over the plane stack; n % g
                     # remainders take the per-gen sharded plane runner
                     g = (gens_per_exchange if gens_per_exchange > 1
@@ -260,24 +270,10 @@ class Engine:
                     self._run = sharded.make_multi_step_generations(
                         mesh, self.rule, topology, donate=True)
             elif backend == "sparse":
-                if sparse_opts:
-                    warnings.warn(
-                        "sparse_opts (tile_rows/tile_words/capacity) apply to "
-                        "the single-device sparse engine only; the sharded "
-                        "sparse path skips at per-device granularity and "
-                        "ignores them",
-                        stacklevel=3,
-                    )
                 # per-device activity skipping: flags ride along with state
-                self._flags = sharded.initial_flags(mesh)
-                run2 = sharded.make_multi_step_packed_sparse(mesh, self.rule, topology,
-                                                             donate=True)
-
-                def _run(s, n):
-                    s, self._flags = run2(s, self._flags, n)
-                    return s
-
-                self._run = _run
+                self._run = self._flagged_sparse_runner(
+                    sharded.make_multi_step_packed_sparse(
+                        mesh, self.rule, topology, donate=True), mesh)
             elif backend == "pallas":
                 # row-band native kernel: exchange a depth-g halo, advance g
                 # gens in the Mosaic slab kernel, crop (parallel/sharded.py
@@ -410,6 +406,18 @@ class Engine:
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
         self._state = state
+
+    def _flagged_sparse_runner(self, run2, mesh: Mesh):
+        """Wrap a sharded sparse runner (binary bitboard or Generations
+        plane stack — both return ``(state, flags)``) so the per-device
+        activity flags ride along with the engine state."""
+        self._flags = sharded.initial_flags(mesh)
+
+        def _run(s, n):
+            s, self._flags = run2(s, self._flags, n)
+            return s
+
+        return _run
 
     def _resolve_auto(self, grid, mesh: Optional[Mesh], topology: Topology,
                       gens_per_exchange: int = 1) -> str:

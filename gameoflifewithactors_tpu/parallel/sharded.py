@@ -111,27 +111,43 @@ def make_multi_step_packed_sparse(
     tile-compare pass (the next generation's flag); quiet tiles pay only
     the halo exchange.
     """
+    return _make_flagged_sparse(
+        mesh, _SPEC,
+        lambda tile, nx, ny: exchange_halo(tile, nx, ny, topology),
+        lambda ext: packed_ops.step_packed_ext(ext, rule),
+        topology, donate)
+
+
+def _make_flagged_sparse(mesh, state_spec, exchange, step_ext, topology,
+                         donate):
+    """The shared per-device activity-skipping runner for both layouts
+    (2D bitboard, Generations plane stack). ``exchange(state, nx, ny)``
+    runs UNCONDITIONALLY — halo ppermutes are collectives and every device
+    must participate even while asleep; only the local stencil
+    ``step_ext(ext)`` hides behind the ``lax.cond`` activity gate. The
+    flags make their own (3, 3)-neighborhood trip."""
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
-    def gen(tile, flag):
-        ext = exchange_halo(tile, nx, ny, topology)
+    def gen(state, flag):
+        ext = exchange(state, nx, ny)
         fext = exchange_halo(flag, nx, ny, topology)  # (3, 3) neighborhood
 
         def do(_):
-            new = packed_ops.step_packed_ext(ext, rule)
-            changed = jnp.any(new != tile).astype(jnp.uint32).reshape(1, 1)
+            new = step_ext(ext)
+            changed = jnp.any(new != state).astype(jnp.uint32).reshape(1, 1)
             return new, changed
 
         def skip(_):
             # flag & 0 (not a fresh zeros constant) keeps the value tagged
             # as device-varying, matching do()'s outputs under shard_map
-            return tile, flag & 0
+            return state, flag & 0
 
         return jax.lax.cond(jnp.sum(fext) > 0, do, skip, None)
 
-    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, _SPEC, P()), out_specs=(_SPEC, _SPEC))
-    def _run(tile, flag, n):
-        return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (tile, flag))
+    @partial(shard_map, mesh=mesh, in_specs=(state_spec, _SPEC, P()),
+             out_specs=(state_spec, _SPEC))
+    def _run(state, flag, n):
+        return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (state, flag))
 
     return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
 
@@ -289,6 +305,29 @@ def make_multi_step_pallas(
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
     return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step_generations_packed_sparse(
+    mesh: Mesh, rule, topology: Topology = Topology.TORUS,
+    donate: bool = False,
+) -> Callable:
+    """Per-device activity skipping for the Generations plane stack: the
+    multi-state face of :func:`make_multi_step_packed_sparse` (same
+    1-element changed-flag per device, same 3×3 flag-neighborhood wake
+    rule — exact for Generations too, since a cell's next state depends
+    only on its own state and its 3×3 alive neighborhood; decaying tiles
+    keep themselves awake by changing). Returns jitted
+    ``(planes, flags, n) -> (planes, flags)`` on a (b, H, W/32) stack
+    sharded P(None, 'x', 'y')."""
+    from ..ops.packed_generations import n_planes, step_planes_ext
+
+    b = n_planes(rule.states)
+    return _make_flagged_sparse(
+        mesh, P(None, ROW_AXIS, COL_AXIS),
+        lambda planes, nx, ny: exchange_halo_stack(planes, nx, ny, topology),
+        lambda ext: jnp.stack(step_planes_ext(
+            [ext[i] for i in range(b)], rule)),
+        topology, donate)
 
 
 def make_multi_step_generations_pallas(

@@ -113,14 +113,11 @@ def test_engine_rejects_out_of_range_states_and_packed_kernels():
     g = np.full((4, 32), 3, dtype=np.uint8)
     with pytest.raises(ValueError, match="states 0..2"):
         Engine(g, "B2/S/C3")
-    # pallas + Generations (single-device / row bands) and sparse +
-    # Generations (single-device) are real paths now; the sharded
-    # variants that do not exist still reject clearly
+    # pallas (single-device / row bands) and sparse (single-device and
+    # sharded) are real Generations paths now; the one sharded variant
+    # that does not exist still rejects clearly
     from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
 
-    with pytest.raises(ValueError, match="sharded sparse is 3x3-binary"):
-        Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="sparse",
-               mesh=mesh_lib.make_mesh((2, 4)))
     with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
         Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="pallas",
                mesh=mesh_lib.make_mesh((2, 4)))
