@@ -119,6 +119,8 @@ _register("glider", ".X.\n..X\nXXX")
 _register("lwss", ".X..X\nX....\nX...X\nXXXX.")
 _register("r_pentomino", ".XX\nXX.\n.X.")
 _register("acorn", ".X.....\n...X...\nXX..XXX")
+_register("diehard", "......X.\nXX......\n.X...XXX")       # vanishes at gen 130
+_register("pentadecathlon", "..X....X..\nXX.XXXX.XX\n..X....X..")  # period 15
 _register("pulsar", """
 ..XXX...XXX..
 .............

@@ -54,3 +54,27 @@ class ConsoleRenderer:
         self.stream.write("".join(out))
         self.stream.flush()
         self._first = False
+
+
+def save_ppm(grid, path, *, scale: int = 1) -> None:
+    """Write a state grid as a binary PPM (P6) image — the no-dependency
+    image format every viewer and converter reads. State 0 is black, state
+    1 white, dying Generations states fade through greys; ``scale`` scales
+    pixels up for small universes. Also serves 1D spacetime
+    diagrams (rows = time) straight from ops.elementary.evolve_spacetime.
+    """
+    import numpy as np
+
+    g = np.asarray(grid)
+    if g.ndim != 2:
+        raise ValueError(f"grid must be 2D, got shape {g.shape}")
+    top = max(1, int(g.max()))
+    # alive (1) brightest; higher (dying) states darker but visible
+    lum = np.where(g == 0, 0, 255 - (g.astype(np.int32) - 1) * (160 // top))
+    lum = lum.astype(np.uint8)
+    if scale > 1:
+        lum = np.repeat(np.repeat(lum, scale, axis=0), scale, axis=1)
+    h, w = lum.shape
+    with open(path, "wb") as f:
+        f.write(b"P6\n%d %d\n255\n" % (w, h))
+        f.write(np.stack([lum] * 3, axis=-1).tobytes())

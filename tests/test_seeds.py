@@ -61,3 +61,40 @@ def test_seeded_packed_validates():
         seeds.seeded_packed((64, 100), "glider")
     with pytest.raises(ValueError, match="exceeds"):
         seeds.seeded_packed((8, 32), "gosper_gun")
+
+
+def test_new_pattern_dynamics():
+    """Diehard vanishes at exactly generation 130; pentadecathlon has
+    period 15 — classic dynamics as correctness fixtures."""
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.stencil import multi_step
+
+    g = jnp.asarray(seeds.seeded((48, 48), "diehard", 20, 20))
+    alive_129 = np.asarray(multi_step(g, 129, rule=CONWAY)).sum()
+    alive_130 = np.asarray(multi_step(g, 130, rule=CONWAY)).sum()
+    assert alive_129 > 0 and alive_130 == 0
+
+    p = jnp.asarray(seeds.seeded((32, 32), "pentadecathlon", 10, 10))
+    after = multi_step(p, 15, rule=CONWAY)
+    np.testing.assert_array_equal(np.asarray(after), np.asarray(p))
+    assert (np.asarray(multi_step(p, 7, rule=CONWAY)) != np.asarray(p)).any()
+
+
+def test_save_ppm_round_trip(tmp_path):
+    from gameoflifewithactors_tpu.utils.render import save_ppm
+
+    g = np.array([[0, 1], [2, 3]], dtype=np.uint8)
+    path = tmp_path / "frame.ppm"
+    save_ppm(g, path, scale=2)
+    data = path.read_bytes()
+    assert data.startswith(b"P6\n4 4\n255\n")
+    body = data.split(b"255\n", 1)[1]
+    assert len(body) == 4 * 4 * 3
+    # state 0 black, state 1 brightest
+    pix = np.frombuffer(body, np.uint8).reshape(4, 4, 3)
+    assert pix[0, 0, 0] == 0 and pix[0, 2, 0] == 255
+    assert 0 < pix[2, 0, 0] < 255      # dying states grey out
+    with pytest.raises(ValueError, match="2D"):
+        save_ppm(np.zeros((2, 2, 2), np.uint8), tmp_path / "x.ppm")
