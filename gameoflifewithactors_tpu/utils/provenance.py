@@ -1,0 +1,119 @@
+"""Version provenance for persisted performance evidence.
+
+VERDICT.md round-2 Weak #1: a persisted TPU measurement served by bench.py
+after the measured code path was rewritten silently reports numbers for code
+that no longer exists. Every persisted record therefore carries the git
+commit of the tree it measured, and consumers call :func:`staleness` to
+learn whether the record's measured paths changed since that stamp.
+
+Pure stdlib + ``git`` subprocess; degrades to "unknown provenance" (which
+consumers treat as stale) when git is unavailable or the repo is absent —
+evidence must never look *fresher* than it can be proven to be.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The measured code path per bench backend: if any of these files changed
+# after a record's commit stamp, the record describes a predecessor kernel
+# and must be flagged. Conservative supersets: transitively imported shared
+# helpers (_jit donation wrapper, stencil's Topology/rule plumbing, bitpack)
+# are in every set — a rewrite there changes every backend's measured code.
+_SHARED = ["gameoflifewithactors_tpu/ops/_jit.py",
+           "gameoflifewithactors_tpu/ops/stencil.py",
+           "gameoflifewithactors_tpu/ops/bitpack.py",
+           "gameoflifewithactors_tpu/models"]  # rule semantics feed every op
+BACKEND_PATHS = {
+    "pallas": ["gameoflifewithactors_tpu/ops/pallas_stencil.py",
+               "gameoflifewithactors_tpu/ops/packed.py", *_SHARED],
+    "packed": ["gameoflifewithactors_tpu/ops/packed.py",
+               "gameoflifewithactors_tpu/ops/packed_generations.py",
+               "gameoflifewithactors_tpu/ops/packed_ltl.py", *_SHARED],
+    "dense": ["gameoflifewithactors_tpu/ops/generations.py",
+              "gameoflifewithactors_tpu/ops/ltl.py", *_SHARED],
+    "sparse": ["gameoflifewithactors_tpu/ops/sparse.py",
+               "gameoflifewithactors_tpu/ops/packed.py", *_SHARED],
+}
+# Fallback when the backend can't be parsed out of a record: everything.
+ALL_OPS_PATHS = ["gameoflifewithactors_tpu/ops", "gameoflifewithactors_tpu/parallel",
+                 "gameoflifewithactors_tpu/models"]
+
+
+def _git(*args: str, repo: str | None = None) -> str | None:
+    try:
+        r = subprocess.run(["git", *args], cwd=repo or _REPO,
+                           capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return r.stdout.strip() if r.returncode == 0 else None
+
+
+def git_head(repo: str | None = None) -> str | None:
+    """Short hash of HEAD, or None when unknowable."""
+    return _git("rev-parse", "--short", "HEAD", repo=repo)
+
+
+def changed_since(commit: str, paths: list[str], repo: str | None = None) -> list[str] | None:
+    """Files under ``paths`` changed in ``commit..HEAD`` (committed changes),
+    plus any with uncommitted modifications now. None = cannot determine."""
+    log = _git("log", "--name-only", "--format=", f"{commit}..HEAD", "--", *paths,
+               repo=repo)
+    if log is None:
+        return None
+    dirty = _git("status", "--porcelain", "--", *paths, repo=repo)
+    if dirty is None:
+        # can't tell whether the tree is dirty -> can't certify freshness
+        return None
+    files = {ln.strip() for ln in log.splitlines() if ln.strip()}
+    files |= {ln[3:].strip() for ln in dirty.splitlines() if ln.strip()}
+    return sorted(files)
+
+
+def head_stamp(paths: list[str] | None = None, repo: str | None = None) -> dict:
+    """Provenance stamp for a measurement taken NOW: ``{"commit": <head>}``,
+    plus ``"commit_dirty": True`` when the measured paths have uncommitted
+    edits (or dirtiness can't be determined) — a dirty-tree measurement ran
+    code that exists at no commit, so it must never get clean provenance."""
+    stamp: dict = {"commit": git_head(repo=repo)}
+    dirty = _git("status", "--porcelain", "--", *(paths or ALL_OPS_PATHS), repo=repo)
+    if dirty is None or dirty:
+        stamp["commit_dirty"] = True
+    return stamp
+
+
+def staleness(record: dict, repo: str | None = None) -> dict:
+    """Classify a persisted measurement record's provenance.
+
+    Returns ``{"stale": bool, "reason": str}`` — ``stale`` is True when the
+    record has no commit stamp, the stamp can't be checked, or the measured
+    backend's code paths changed since the stamp.
+    """
+    commit = record.get("commit")
+    if not commit:
+        return {"stale": True, "reason": "record has no commit stamp"}
+    if record.get("commit_dirty"):
+        return {"stale": True,
+                "reason": f"measured tree had uncommitted changes at record time ({commit})"}
+    if record.get("commit_approx"):
+        # hand-backfilled stamp: the true measured tree is a guess, so the
+        # record can never be certified fresh even if paths look unchanged
+        return {"stale": True,
+                "reason": f"commit stamp {commit} is approximate (backfilled), "
+                          "cannot certify the measured tree"}
+    backend = None
+    metric = record.get("metric", "")
+    if "(" in metric:  # "... (pallas, 50% soup, tpu)" names the resolved backend
+        backend = metric.rsplit("(", 1)[1].split(",")[0].strip()
+    paths = BACKEND_PATHS.get(backend, ALL_OPS_PATHS)
+    changed = changed_since(commit, paths, repo=repo)
+    if changed is None:
+        return {"stale": True, "reason": f"cannot verify commit {commit} (git unavailable)"}
+    if changed:
+        return {"stale": True,
+                "reason": f"measured paths changed since {commit}: {', '.join(changed[:4])}"
+                          + (f" (+{len(changed) - 4} more)" if len(changed) > 4 else "")}
+    return {"stale": False, "reason": f"measured paths unchanged since {commit}"}

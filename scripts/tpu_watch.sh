@@ -1,30 +1,95 @@
 #!/bin/bash
-# Healthy-window watcher: probe every 5 min; on the first healthy probe,
-# re-capture the round's TPU evidence (worklist items + bench configs),
-# then exit. Safe to re-run; all artifacts merge/persist best-wins.
+# Healthy-window watcher: probe every 5 min; on a healthy probe, re-capture
+# the round's TPU evidence (worklist items + bench configs). Keeps watching
+# until EVERY worklist item has a fresh ok:true stamp from after the watcher
+# started; each retry runs ONLY the still-stale subset, so a wedge
+# mid-capture costs one item's time, not the whole list's, on the next
+# healthy window. Safe to re-run; all artifacts merge/persist best-wins.
 #
 # The probe writes to a FILE, not a pipe: `timeout` kills the probe's
 # parent but a tunnel-wedged orphan child keeps a pipe's write end open,
 # so `| grep -q` would block far past the timeout (observed: 19 min).
 cd /root/repo
+WATCH_T0=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+export WATCH_T0
+ITEMS=pallas_identity,pallas_autotune,pallas_band,pallas_generations,bench_packed,ltl_bosco,generations_brain,profile_trace,config5_sparse
+export ITEMS
 trap 'rm -f "${PROBE_OUT:-}"' EXIT
-for i in $(seq 1 60); do
+
+# A record counts as captured when it is ok AND either (a) recorded this
+# watcher run, or (b) provenance-fresh: commit-stamped, clean tree, and the
+# measured code paths unchanged since (utils/provenance.py). (b) stops a
+# restarted watcher from re-burning TPU windows on evidence that is already
+# current; recorded_at alone can't tell that.
+stale_items() {  # comma list of worklist items needing capture
+  python - <<'EOF'
+import importlib.util, json, os
+spec = importlib.util.spec_from_file_location(
+    "prov", "gameoflifewithactors_tpu/utils/provenance.py")
+prov = importlib.util.module_from_spec(spec); spec.loader.exec_module(prov)
+t0 = os.environ["WATCH_T0"]
+items = os.environ["ITEMS"].split(",")
+try:
+    d = json.load(open("results/tpu_worklist.json"))
+except Exception:
+    d = {}
+def fresh(r):
+    if not r or not r.get("ok"):
+        return False
+    return r.get("recorded_at", "") >= t0 or not prov.staleness(r)["stale"]
+print(",".join(k for k in items if not fresh(d.get(k))))
+EOF
+}
+
+bench_stale() {  # bench --size values (or "default") needing capture
+  python - <<'EOF'
+import importlib.util, json, os
+spec = importlib.util.spec_from_file_location(
+    "prov", "gameoflifewithactors_tpu/utils/provenance.py")
+prov = importlib.util.module_from_spec(spec); spec.loader.exec_module(prov)
+t0 = os.environ["WATCH_T0"]
+try:
+    d = json.load(open("results/tpu_best.json"))
+except Exception:
+    d = {}
+for size in ("default", "1024", "8192"):
+    r = d.get(f"auto:{size}:B3/S23")
+    ok = r and (r.get("recorded_at", "") >= t0 or not prov.staleness(r)["stale"])
+    if not ok:
+        print(size)
+EOF
+}
+
+for i in $(seq 1 200); do
   # fresh file per iteration: a SIGTERM-surviving wedged probe from an
   # earlier round still holds an fd and could scribble on a reused file
   rm -f "${PROBE_OUT:-}"
   PROBE_OUT=$(mktemp)
   timeout 90 python scripts/tpu_probe.py > "$PROBE_OUT" 2>/dev/null
   if grep -q '^healthy' "$PROBE_OUT"; then
-    echo "=== healthy at $(date -u +%H:%M:%S), capturing ==="
-    timeout 3000 python scripts/tpu_worklist.py --force \
-      --items pallas_identity,pallas_band,pallas_generations,bench_packed,ltl_bosco,generations_brain,profile_trace,config5_sparse
-    timeout 600 python bench.py --no-probe
-    timeout 600 python bench.py --no-probe --size 1024
-    timeout 600 python bench.py --no-probe --size 8192
-    echo "=== capture done at $(date -u +%H:%M:%S) ==="
-    exit 0
+    STALE=$(stale_items)
+    echo "=== healthy at $(date -u +%H:%M:%S), capturing stale: ${STALE:-none} ==="
+    if [ -n "$STALE" ]; then
+      timeout 4200 python scripts/tpu_worklist.py --force --items "$STALE"
+    fi
+    # bench configs gated on their own freshness, same as worklist items —
+    # a deterministic worklist failure must not re-burn three bench runs
+    # (30 min of window) every 5-minute cycle
+    for size in $(bench_stale); do
+      if [ "$size" = default ]; then
+        timeout 600 python bench.py --no-probe
+      else
+        timeout 600 python bench.py --no-probe --size "$size"
+      fi
+    done
+    if [ -z "$(stale_items)" ] && [ -z "$(bench_stale)" ]; then
+      echo "=== capture complete (all items fresh) at $(date -u +%H:%M:%S) ==="
+      exit 0
+    fi
+    echo "=== capture partial at $(date -u +%H:%M:%S); continuing watch ==="
+  else
+    echo "probe $i: $(head -c 60 "$PROBE_OUT") at $(date -u +%H:%M:%S)"
   fi
-  echo "probe $i: $(head -c 60 "$PROBE_OUT") at $(date -u +%H:%M:%S)"
   sleep 300
 done
-echo "gave up after 60 probes"
+echo "gave up after 200 probes"

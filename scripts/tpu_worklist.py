@@ -68,7 +68,14 @@ def child_bench_packed() -> dict:
                  if ln.startswith("{")), None)
     if r.returncode or line is None:
         return {"ok": False, "detail": (r.stderr or r.stdout)[-800:]}
-    return {"ok": True, **json.loads(line)}
+    result = json.loads(line)
+    if result.get("persisted"):
+        # bench fell back to its persisted store: NOT a fresh measurement —
+        # marking it ok would let the watcher count an un-re-measured item
+        # as captured and exit without real TPU evidence
+        return {"ok": False, **result,
+                "detail": "bench served a persisted record; no fresh TPU measurement"}
+    return {"ok": True, **result}
 
 
 def child_pallas_identity() -> dict:
@@ -462,6 +469,18 @@ ITEMS = {
 _INPROC_ITEMS = [k for k in ITEMS if k not in ("bench_packed", "config5_sparse")]
 
 
+def _provenance():
+    """Load utils/provenance.py WITHOUT the package __init__ (which imports
+    jax — a hang when the tunnel is wedged; this parent must stay jax-free)."""
+    import importlib.util
+
+    path = os.path.join(_REPO, "gameoflifewithactors_tpu", "utils", "provenance.py")
+    spec = importlib.util.spec_from_file_location("_worklist_provenance", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _merge(item: str, result: dict) -> None:
     try:
         with open(OUT_PATH) as f:
@@ -471,12 +490,17 @@ def _merge(item: str, result: dict) -> None:
     prev = store.get(item)
     # keep a previous ok result over a new failure; otherwise replace
     if not (prev and prev.get("ok") and not result.get("ok")):
-        store[item] = {**result,
+        # head_stamp FIRST in the spread: a result that already carries a
+        # commit (e.g. a persisted bench record) keeps its own provenance —
+        # re-stamping old evidence with current HEAD would launder it
+        store[item] = {**_provenance().head_stamp(),
+                       **result,
                        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
     tmp = OUT_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(store, f, indent=1)
+        f.write("\n")
     os.replace(tmp, OUT_PATH)
 
 
