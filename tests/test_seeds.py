@@ -98,3 +98,21 @@ def test_save_ppm_round_trip(tmp_path):
     assert 0 < pix[2, 0, 0] < 255      # dying states grey out
     with pytest.raises(ValueError, match="2D"):
         save_ppm(np.zeros((2, 2, 2), np.uint8), tmp_path / "x.ppm")
+
+
+def test_save_ppm_many_state_fade_distinct(tmp_path):
+    # advisor round-2: integer 160 // top collapsed to a 0 step past 160
+    # states, rendering every dying state alive-white; the float fade must
+    # keep dying states below alive and monotonically darkening
+    from gameoflifewithactors_tpu.utils.render import save_ppm
+
+    states = np.arange(256, dtype=np.int32).reshape(16, 16)
+    path = tmp_path / "fade.ppm"
+    save_ppm(states, path)
+    body = path.read_bytes().split(b"255\n", 1)[1]
+    lum = np.frombuffer(body, np.uint8).reshape(16, 16, 3)[:, :, 0].ravel()
+    assert lum[0] == 0 and lum[1] == 255          # dead black, alive white
+    dying = lum[2:]
+    assert dying.max() < 255                      # no dying state reads alive
+    assert (np.diff(dying.astype(int)) <= 0).all()  # monotone fade
+    assert dying.min() >= 95                      # still visible vs dead black
