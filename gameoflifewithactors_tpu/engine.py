@@ -62,13 +62,13 @@ class Engine:
     mesh: optional jax Mesh for 2D sharding; None = single device.
     backend: "auto" (default: the fastest correct path — on TPU that is
         the "pallas" kernel for 3x3 binary rules single-device and on
-        TORUS (nx, 1) row-band meshes at supported shapes, "packed"
-        otherwise), "packed" (32 cells/word SWAR fast path), "dense"
-        (1 byte/cell, debug path), "pallas" (temporal-blocked Mosaic
-        kernel advancing several generations per HBM round-trip; serves
-        3x3 binary rules single-device and on (nx, 1) TORUS meshes, and
-        Generations rules single-device and on (nx, 1) TORUS meshes via
-        the bit-plane kernel), or "sparse" (activity-tiled: compute
+        (nx, 1) row-band meshes at supported shapes, either topology,
+        "packed" otherwise), "packed" (32 cells/word SWAR fast path),
+        "dense" (1 byte/cell, debug path), "pallas" (temporal-blocked
+        Mosaic kernel advancing several generations per HBM round-trip;
+        serves 3x3 binary rules and Generations rules, single-device and
+        on (nx, 1) meshes — DEAD vertical closure rides a per-device SMEM
+        edge code), or "sparse" (activity-tiled: compute
         scales with changed area, for huge mostly-empty universes;
         3x3 binary bitboards and, single-device, Generations bit-plane
         stacks; both topologies on one device — torus refreshes the halo
@@ -277,9 +277,9 @@ class Engine:
             elif backend == "pallas":
                 # row-band native kernel: exchange a depth-g halo, advance g
                 # gens in the Mosaic slab kernel, crop (parallel/sharded.py
-                # make_multi_step_pallas — TORUS, (nx, 1) meshes only; it
-                # raises with directions otherwise). n % g remainders take
-                # the per-gen SWAR runner.
+                # make_multi_step_pallas — (nx, 1) meshes, both topologies;
+                # it raises with directions otherwise). n % g remainders
+                # take the per-gen SWAR runner.
                 g = (gens_per_exchange if gens_per_exchange > 1
                      else pallas_stencil.DEFAULT_GENS_PER_CALL)
                 self.gens_per_exchange = g
@@ -422,10 +422,11 @@ class Engine:
     def _resolve_auto(self, grid, mesh: Optional[Mesh], topology: Topology,
                       gens_per_exchange: int = 1) -> str:
         """'auto' = the fastest correct backend for this rule/platform/shape:
-        the temporal-blocked native Pallas kernel (measured 1.78e12
-        cell-updates/s on a v5e, ~10x the XLA SWAR rate) for 3x3 binary
-        rules at shapes it supports — single-device, and TORUS (nx, 1)
-        row-band meshes on TPU; the packed SWAR path everywhere else. Off
+        the temporal-blocked native Pallas kernel (canonical-protocol
+        1.33e12 cell-updates/s on a v5e, ~7.6x the XLA SWAR rate) for 3x3
+        binary rules at shapes it supports — single-device, and (nx, 1)
+        row-band meshes on TPU, either topology; the packed SWAR path
+        everywhere else. Off
         'packed', Generations rules take the bit-plane stack when the width
         packs (% 32), the byte path otherwise; LtL picks bit-sliced packed
         on TPU and the byte path elsewhere (see the platform note below)."""
@@ -452,9 +453,10 @@ class Engine:
         if len(shape) != 2 or shape[1] % bitpack.WORD:
             return "packed"  # shape errors surface in the main path
         if mesh is not None:
-            # native row-band path: TORUS (nx, 1) meshes whose bands keep
-            # the kernel's alignment (width % 4096, extended band height
-            # divisible into 8-row blocks: th % 8, exchange depth % 8).
+            # native row-band path: (nx, 1) meshes whose bands keep the
+            # kernel's alignment (width % 4096, extended band height
+            # divisible into 8-row blocks: th % 8, exchange depth % 8);
+            # both topologies (DEAD rides the kernel's SMEM edge code).
             # An explicit gens_per_exchange the slab kernel cannot honor
             # (not a multiple of 8, or deeper than the band) must keep
             # resolving to the packed deep runner, as it did before the
@@ -464,8 +466,7 @@ class Engine:
             th = shape[0] // nx if shape[0] % nx == 0 else 0
             g = (gens_per_exchange if gens_per_exchange > 1
                  else pallas_stencil.DEFAULT_GENS_PER_CALL)
-            if (on_tpu and ny == 1 and topology is Topology.TORUS
-                    and th > 0
+            if (on_tpu and ny == 1 and th > 0
                     and pallas_stencil.band_supported(
                         th, g, native=True,
                         wp=shape[1] // bitpack.WORD)

@@ -66,6 +66,30 @@ def _zero_edge_rows(slab, block_idx, n_blocks, halo, row_axis: int = 0):
     return jnp.where(top_ext | bot_ext, jnp.uint32(0), slab)
 
 
+def _zero_band_exterior(slab, block_idx, bh, g, k, He, edge_ref,
+                        row_axis: int = 0):
+    """Per-generation re-zero of the permanently-dead exterior of a
+    global-edge row band (slab mode, DEAD vertical closure). The extended
+    band's outer g rows are exterior on a global-edge device — cells born
+    there by the free slab evolution would feed back into the interior from
+    the 2nd in-slab generation on (the same failure mode full-grid DEAD
+    guards against). Masks by GLOBAL extended-row index: the slab shrinks
+    2 rows per in-slab generation, so after ``k`` generations slab row
+    ``s`` is extended row ``block*bh + s - (g - k)``; global indexing also
+    keeps any block decomposition correct (with bh < 2g the exterior spans
+    two blocks). Gated at runtime by the device's edge code (bit0 = global
+    top band, bit1 = bottom), an SMEM scalar — the compiled program is
+    shared by every device in the shard_map, so edge-ness must be data,
+    not code.
+    """
+    code = edge_ref[0, 0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, row_axis)
+    ext_row = block_idx * bh + rows - (g - k)
+    top = ((code & 1) == 1) & (ext_row < g)
+    bot = ((code & 2) == 2) & (ext_row >= He - g)
+    return jnp.where(top | bot, jnp.uint32(0), slab)
+
+
 def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks, stack: bool):
     """The shared double-buffered 3-segment input pipeline: start block
     i+1's copies, wait on block i's (started by the previous grid step or
@@ -117,7 +141,7 @@ def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks, stack: bool):
 
 
 def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
-                 g: int, slab_mode: bool = False):
+                 g: int, slab_mode: bool = False, dead_band: bool = False):
     """The temporal-blocked kernel body, in one of two closure modes.
 
     Full-grid mode (``slab_mode=False``): the H rows are the whole universe;
@@ -137,11 +161,22 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
     *global horizontal* closure only (TORUS wraps in-VMEM across the full
     width, globally correct for row bands; vertical global wrap rides the
     halo exchange outside).
+
+    ``dead_band`` (slab mode only): global DEAD *vertical* closure for the
+    band runners — the kernel takes an extra (1, 1) int32 SMEM edge code
+    (bit0 = this device holds the global top band, bit1 = bottom) and
+    re-zeroes the permanently-dead exterior rows before every in-slab
+    generation on edge devices (_zero_band_exterior). Interior devices
+    (code 0) evolve their halos freely, exactly like the TORUS form.
     """
     n_blocks = H // bh
     L = bh + 2 * g
 
-    def kernel(p_hbm, out_ref, slab_ref, sems):
+    def kernel(p_hbm, *refs):
+        if dead_band:
+            edge_ref, out_ref, slab_ref, sems = refs
+        else:
+            out_ref, slab_ref, sems = refs
         i = pl.program_id(0)
         buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks,
                             stack=False)
@@ -150,6 +185,8 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
             for k in range(g):
                 if k == 0:
                     slab = _zero_edge_rows(slab, i, n_blocks, g)
+                if dead_band:
+                    slab = _zero_band_exterior(slab, i, bh, g, k, H, edge_ref)
                 slab = step_rows(slab, rule, topology)
         else:
             for k in range(g):
@@ -162,22 +199,29 @@ def _make_kernel(rule: Rule, topology: Topology, H: int, Wp: int, bh: int,
 
 
 def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
-                     bh: int, g: int, slab_mode: bool = False):
+                     bh: int, g: int, slab_mode: bool = False,
+                     dead_band: bool = False):
     """Temporal-blocked kernel for the Generations bit-plane stack: the
     (b, H, Wp) planes ride the same 3-segment double-buffered DMA scheme
     (leading plane axis copied whole per segment), the in-VMEM loop steps
     packed_generations.step_planes_slab, and DEAD re-zeroes the exterior
     rows of boundary blocks every generation exactly like the binary form.
     ``slab_mode`` has the same two closure modes as _make_kernel: the H
-    rows are a halo-extended row band, out-of-range DMA payloads are
-    zeroed once, and no per-generation re-zero happens.
+    rows are a halo-extended row band and out-of-range DMA payloads are
+    zeroed once; ``dead_band`` adds the same SMEM edge-code per-generation
+    exterior re-zero as the binary slab form (_zero_band_exterior,
+    row_axis=1).
     """
     from .packed_generations import step_planes_slab
 
     n_blocks = H // bh
     L = bh + 2 * g
 
-    def kernel(p_hbm, out_ref, slab_ref, sems):
+    def kernel(p_hbm, *refs):
+        if dead_band:
+            edge_ref, out_ref, slab_ref, sems = refs
+        else:
+            out_ref, slab_ref, sems = refs
         i = pl.program_id(0)
         buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks,
                             stack=True)
@@ -186,6 +230,9 @@ def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
             if slab_mode:
                 if k == 0:
                     slab = _zero_edge_rows(slab, i, n_blocks, g, row_axis=1)
+                if dead_band:
+                    slab = _zero_band_exterior(slab, i, bh, g, k, H, edge_ref,
+                                               row_axis=1)
             elif topology is Topology.DEAD:
                 slab = _zero_edge_rows(slab, i, n_blocks, g - k, row_axis=1)
             plist = step_planes_slab(
@@ -232,15 +279,21 @@ def _validate_slab(He: int, bh: int, g: int, interpret: bool,
 
 
 def _gen_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
-                     interpret: bool, slab_mode: bool):
+                     interpret: bool, slab_mode: bool,
+                     dead_band: bool = False):
     b, H, Wp = shape
     kernel, n_blocks, L = _make_gen_kernel(rule, topology, b, H, Wp, bh, g,
-                                           slab_mode=slab_mode)
+                                           slab_mode=slab_mode,
+                                           dead_band=dead_band)
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    if dead_band:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                     memory_space=pltpu.SMEM))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, H, Wp), jnp.uint32),
         grid=(n_blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, bh, Wp), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -271,19 +324,21 @@ def make_pallas_gen_slab_step(
     gens: int,
     block_rows: Optional[int] = None,
     interpret: bool = False,
+    dead_band: bool = False,
 ):
     """``ext (b, He, Wp) -> (b, He, Wp)`` advancing ``gens`` generations of
     a halo-extended full-width Generations row band (He = band + 2*gens);
     the caller crops ``out[:, gens:-gens]``. Same contract as
-    :func:`make_pallas_slab_step`, plane-stack form; shard_map callers
-    need ``check_vma=False``."""
+    :func:`make_pallas_slab_step`, plane-stack form (incl. ``dead_band``'s
+    extra (1, 1) edge-code operand); shard_map callers need
+    ``check_vma=False``."""
     b, He, Wp = ext_shape
     g = int(gens)
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=g, g=g,
                                 Wp=Wp * b)
     _validate_slab(He, bh, g, interpret, Wp=Wp, planes=b)
     return _gen_pallas_call(rule, topology, (b, He, Wp), bh, g, interpret,
-                            slab_mode=True)
+                            slab_mode=True, dead_band=dead_band)
 
 
 def multi_step_pallas_generations(
@@ -322,15 +377,19 @@ def multi_step_pallas_generations(
 
 @lru_cache(maxsize=64)
 def _build_slab_runner(rule: Rule, topology: Topology, ext_shape, bh: int,
-                       g: int, interpret: bool):
+                       g: int, interpret: bool, dead_band: bool = False):
     He, Wp = ext_shape
     kernel, n_blocks, L = _make_kernel(rule, topology, He, Wp, bh, g,
-                                       slab_mode=True)
+                                       slab_mode=True, dead_band=dead_band)
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    if dead_band:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                     memory_space=pltpu.SMEM))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((He, Wp), jnp.uint32),
         grid=(n_blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((2, L, Wp), jnp.uint32),      # revolving slab buffers
@@ -348,19 +407,24 @@ def make_pallas_slab_step(
     gens: int,
     block_rows: Optional[int] = None,
     interpret: bool = False,
+    dead_band: bool = False,
 ):
     """``ext (He, Wp) -> (He, Wp)`` advancing ``gens`` generations of a
     halo-extended full-width row band (He = band rows + 2*gens); the caller
     crops ``out[gens:-gens]`` for the exact band interior. ``topology`` is
-    the global horizontal closure (see _make_kernel slab mode). Note: a caller
-    wrapping this in shard_map must pass ``check_vma=False`` — the vma
-    checker cannot type the kernel's scratch-DMA primitives."""
+    the global horizontal closure (see _make_kernel slab mode).
+    ``dead_band=True`` adds a second (1, 1) int32 operand — the device's
+    global-edge code (bit0 top, bit1 bottom) — and realizes the permanently
+    dead exterior on edge bands under a global DEAD vertical closure.
+    Note: a caller wrapping this in shard_map must pass ``check_vma=False``
+    — the vma checker cannot type the kernel's scratch-DMA primitives."""
     He, Wp = ext_shape
     g = int(gens)
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=g,
                                 g=g, Wp=Wp)
     _validate_slab(He, bh, g, interpret, Wp=Wp)
-    return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret)
+    return _build_slab_runner(rule, topology, (He, Wp), bh, g, interpret,
+                              dead_band=dead_band)
 
 
 def band_supported(band_rows: int, g: int, *, native: bool,

@@ -79,22 +79,18 @@ def make_multi_step_pallas_batched(
     """The DP × native-kernel corner of the parallelism matrix: a (nb, nx,
     1) mesh where every device advances its universes' full-width row bands
     through the Mosaic slab kernel (parallel/sharded.py
-    make_multi_step_pallas has the band/TORUS rationale; the same
-    restrictions apply). One depth-g ppermute per side per chunk carries
-    ALL local universes (halo.exchange_rows_stack); each universe then runs
-    its own kernel call — a static loop, not vmap, because vmapping a
-    manual-DMA pallas_call is unsupported territory.
+    make_multi_step_pallas has the band rationale and the SMEM edge-code
+    DEAD closure; the same restrictions apply). One depth-g ppermute per
+    side per chunk carries ALL local universes (halo.exchange_rows_stack);
+    each universe then runs its own kernel call — a static loop, not vmap,
+    because vmapping a manual-DMA pallas_call is unsupported territory.
 
     Returns jitted ``(grids, chunks) -> grids`` over a (B, H, W/32) packed
     batch advancing ``chunks * g`` generations.
     """
     from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
-    from .halo import exchange_rows_stack
+    from .halo import band_edge_code, exchange_rows_stack
 
-    if topology is not Topology.TORUS:
-        raise ValueError(
-            "make_multi_step_pallas_batched supports TORUS only (see "
-            "make_multi_step_pallas); use make_multi_step_packed_batched")
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
     if ny != 1:
         raise ValueError(
@@ -105,6 +101,8 @@ def make_multi_step_pallas_batched(
         interpret = default_interpret()
     spec = P(BATCH_AXIS, ROW_AXIS, None)
 
+    dead = topology is Topology.DEAD
+
     def chunk(tiles):
         if g > tiles.shape[1]:  # static shapes: caught at trace time
             raise ValueError(
@@ -113,8 +111,12 @@ def make_multi_step_pallas_batched(
         ext = exchange_rows_stack(tiles, nx, topology, depth=g)
         call = make_pallas_slab_step(
             rule, topology, ext.shape[1:], gens=g, block_rows=block_rows,
-            interpret=interpret)
-        out = [call(ext[i])[g:-g] for i in range(ext.shape[0])]
+            interpret=interpret, dead_band=dead)
+        if dead:
+            edge = band_edge_code(nx)
+            out = [call(ext[i], edge)[g:-g] for i in range(ext.shape[0])]
+        else:
+            out = [call(ext[i])[g:-g] for i in range(ext.shape[0])]
         return jax.numpy.stack(out)
 
     # check_vma=False: same scratch-DMA typing limitation as

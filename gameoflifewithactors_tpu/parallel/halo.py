@@ -43,6 +43,17 @@ def _shift_perm(n: int, direction: int, wrap: bool) -> List[Tuple[int, int]]:
     return perm
 
 
+def band_edge_code(nx: int, axis: str = ROW_AXIS) -> jax.Array:
+    """This device's global-edge code for row-band decompositions, as the
+    (1, 1) int32 SMEM operand the dead_band slab kernels consume
+    (ops/pallas_stencil.py _zero_band_exterior): bit0 = the device holds
+    the global top band, bit1 = the bottom. One definition for every band
+    runner so the bit contract can't drift between them. shard_map only."""
+    ix = lax.axis_index(axis)
+    return (jnp.where(ix == 0, 1, 0)
+            | jnp.where(ix == nx - 1, 2, 0)).astype(jnp.int32).reshape(1, 1)
+
+
 def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis: str = ROW_AXIS,
                   depth: int = 1) -> jax.Array:
     """(h, w) tile -> (h+2·depth, w) with north/south halo strips of

@@ -29,6 +29,7 @@ from ..ops import packed as packed_ops
 from ..ops import stencil as stencil_ops
 from ..ops.stencil import Topology
 from .halo import (
+    band_edge_code,
     exchange_cols,
     exchange_halo,
     exchange_halo_stack,
@@ -257,21 +258,20 @@ def make_multi_step_pallas(
     horizontal halo word to creep through, so g is bounded only by the band
     height (and by redundant-compute appetite, 2g rows/band/chunk).
 
-    TORUS only: a DEAD *vertical* closure needs the permanently-dead
-    exterior re-zeroed inside every in-slab generation for global-edge
-    bands, which the slab kernel (fixed per-device program) cannot decide
-    per device; use make_multi_step_packed_deep for DEAD topologies.
+    DEAD topology: the permanently-dead exterior of a global-edge band must
+    be re-zeroed inside every in-slab generation (a birth just outside the
+    edge feeds back from the 2nd generation on). The compiled kernel is one
+    program shared by all devices, so edge-ness travels as *data*: each
+    device passes a (1, 1) SMEM edge code (bit0 = holds the global top,
+    bit1 = bottom) from its ``axis_index``, and the kernel's
+    ``_zero_band_exterior`` realizes the dead exterior only where the code
+    says so — interior bands evolve their halos freely, exactly as TORUS.
 
     Returns jitted ``(grid, chunks) -> grid`` advancing ``chunks * g``
     generations (``chunks`` traced, g static), grid sharded P('x', None).
     """
     from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
 
-    if topology is not Topology.TORUS:
-        raise ValueError(
-            "make_multi_step_pallas supports TORUS only (a DEAD vertical "
-            "closure needs per-device exterior re-zeroing inside the "
-            "kernel); use make_multi_step_packed_deep for DEAD")
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
     if ny != 1:
         raise ValueError(
@@ -284,6 +284,8 @@ def make_multi_step_pallas(
 
     band_spec = P(ROW_AXIS, None)
 
+    dead = topology is Topology.DEAD
+
     def chunk(tile):
         if g > tile.shape[0]:  # static shapes: caught at trace time
             raise ValueError(
@@ -292,7 +294,9 @@ def make_multi_step_pallas(
         ext = exchange_rows(tile, nx, topology, depth=g)
         call = make_pallas_slab_step(
             rule, topology, ext.shape, gens=g, block_rows=block_rows,
-            interpret=interpret)
+            interpret=interpret, dead_band=dead)
+        if dead:
+            return call(ext, band_edge_code(nx))[g:-g]
         return call(ext)[g:-g]
 
     # check_vma=False: jax's varying-manual-axes checker cannot type the
@@ -341,17 +345,14 @@ def make_multi_step_generations_pallas(
 ) -> Callable:
     """Row-band sharding over the Generations bit-plane kernel: the
     multi-state twin of :func:`make_multi_step_pallas` (same (nx, 1)
-    TORUS-only contract, same depth-g exchange/crop scheme — see that
-    docstring for the rationale), with ONE stacked ppermute per side per
-    chunk carrying all b planes (halo.exchange_rows_stack). Returns jitted
-    ``(planes, chunks) -> planes`` on a (b, H, W/32) stack sharded
-    P(None, 'x', None), advancing ``chunks * g`` generations."""
+    contract, same depth-g exchange/crop scheme, same SMEM edge-code
+    realization of DEAD vertical closure — see that docstring), with ONE
+    stacked ppermute per side per chunk carrying all b planes
+    (halo.exchange_rows_stack). Returns jitted ``(planes, chunks) ->
+    planes`` on a (b, H, W/32) stack sharded P(None, 'x', None), advancing
+    ``chunks * g`` generations."""
     from ..ops.pallas_stencil import default_interpret, make_pallas_gen_slab_step
 
-    if topology is not Topology.TORUS:
-        raise ValueError(
-            "make_multi_step_generations_pallas supports TORUS only (see "
-            "make_multi_step_pallas); use make_multi_step_generations_packed")
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
     if ny != 1:
         raise ValueError(
@@ -363,6 +364,8 @@ def make_multi_step_generations_pallas(
 
     spec3 = P(None, ROW_AXIS, None)
 
+    dead = topology is Topology.DEAD
+
     def chunk(planes):
         if g > planes.shape[1]:  # static shapes: caught at trace time
             raise ValueError(
@@ -371,7 +374,9 @@ def make_multi_step_generations_pallas(
         ext = exchange_rows_stack(planes, nx, topology, depth=g)
         call = make_pallas_gen_slab_step(
             rule, topology, ext.shape, gens=g, block_rows=block_rows,
-            interpret=interpret)
+            interpret=interpret, dead_band=dead)
+        if dead:
+            return call(ext, band_edge_code(nx))[:, g:-g]
         return call(ext)[:, g:-g]
 
     # check_vma=False: same scratch-DMA typing limitation as the binary

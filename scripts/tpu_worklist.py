@@ -307,17 +307,21 @@ def child_pallas_band() -> dict:
     m = mesh_lib.make_mesh((1, 1), jax.devices()[:1])
     rng = np.random.default_rng(11)
     out = {"platform": jax.devices()[0].platform, "cases": []}
-    for (h, w), g, chunks in (((1024, 4096), 8, 2), ((512, 8192), 16, 3)):
+    # both topologies: DEAD proves the SMEM edge-code exterior re-zero
+    # (dead_band kernel variant) compiles and is exact natively
+    for (h, w), g, chunks, topo in (
+            ((1024, 4096), 8, 2, Topology.TORUS),
+            ((512, 8192), 16, 3, Topology.TORUS),
+            ((1024, 4096), 8, 2, Topology.DEAD)):
         grid = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
         p = bitpack.pack(jnp.asarray(grid))
-        want = multi_step_packed(p, g * chunks, rule=CONWAY,
-                                 topology=Topology.TORUS)
+        want = multi_step_packed(p, g * chunks, rule=CONWAY, topology=topo)
         run = sharded.make_multi_step_pallas(
-            m, CONWAY, gens_per_exchange=g, interpret=False)
+            m, CONWAY, topology=topo, gens_per_exchange=g, interpret=False)
         got = run(mesh_lib.device_put_sharded_grid(p, m), chunks)
         same = _device_equal(got, want)
         out["cases"].append({"shape": [h, w], "g": g, "chunks": chunks,
-                             "bit_identical": same})
+                             "topology": topo.value, "bit_identical": same})
         if not same:
             out["ok"] = False
             return out

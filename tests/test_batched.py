@@ -55,24 +55,26 @@ def test_batch_mesh_validation():
         batched.make_batch_mesh((3, 2, 2))
 
 
+@pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
 @pytest.mark.parametrize("mesh_shape,B,H,g", [
     ((2, 4, 1), 4, 32, 3),   # 2 universes/device, 8-row bands
     ((4, 2, 1), 4, 64, 8),   # 1 universe/device, 32-row bands
 ])
-def test_batched_pallas_band_bit_identity(mesh_shape, B, H, g):
+def test_batched_pallas_band_bit_identity(mesh_shape, B, H, g, topology):
     """DP x row-band native-kernel composition (interpret mode): every
-    universe must match its own single-device packed evolution."""
+    universe must match its own single-device packed evolution — DEAD
+    exercises the SMEM edge-code exterior re-zero through the DP stack."""
     rng = np.random.default_rng(31)
     grids = rng.integers(0, 2, size=(B, H, 64), dtype=np.uint8)
     packed = jnp.stack([bitpack.pack(jnp.asarray(u)) for u in grids])
 
     mesh = batched.make_batch_mesh(mesh_shape)
     run = batched.make_multi_step_pallas_batched(
-        mesh, CONWAY, gens_per_exchange=g, interpret=True)
+        mesh, CONWAY, topology=topology, gens_per_exchange=g, interpret=True)
     out = run(jax.device_put(packed, batched.batch_sharding(mesh)), 2)
     for i in range(B):
         want = multi_step_packed(packed[i], 2 * g, rule=CONWAY,
-                                 topology=Topology.TORUS)
+                                 topology=topology)
         np.testing.assert_array_equal(
             np.asarray(out[i]), np.asarray(want),
             err_msg=f"universe {i} diverged on mesh {mesh_shape}")
@@ -82,7 +84,3 @@ def test_batched_pallas_band_rejections():
     with pytest.raises(ValueError, match=r"\(nb, nx, 1\) row-band"):
         batched.make_multi_step_pallas_batched(
             batched.make_batch_mesh((2, 2, 2)), CONWAY)
-    with pytest.raises(ValueError, match="TORUS only"):
-        batched.make_multi_step_pallas_batched(
-            batched.make_batch_mesh((2, 4, 1)), CONWAY,
-            topology=Topology.DEAD)
