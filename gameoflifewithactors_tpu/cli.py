@@ -16,11 +16,83 @@ from .config import from_args
 from .utils.render import ConsoleRenderer
 
 
+def _run_elementary(cfg, args, rule) -> int:
+    """The 1D (Wolfram W0..255) route: evolve the full spacetime diagram on
+    device in one lax.scan dispatch (ops/elementary.evolve_spacetime), then
+    render rows-as-time. VERDICT round-2 item #7 — the one rule family the
+    2D Engine cannot drive gets its own CLI surface instead: ``--grid HxW``
+    contributes the row width W (H is ignored — time is the vertical axis),
+    ``--steps`` the generation count, ``--seed`` center (default) / random /
+    empty, ``--render final`` an ASCII diagram, ``--ppm`` the image."""
+    import numpy as np
+
+    from .ops import bitpack
+    from .ops.elementary import evolve_spacetime
+    from .ops.stencil import Topology
+
+    import jax.numpy as jnp
+
+    # flags this route cannot honor must fail loudly, not exit 0 without
+    # the requested side effect (a later --resume on the missing file
+    # would fail far from the cause)
+    for flag, value in (("--checkpoint", cfg.checkpoint),
+                        ("--metrics", cfg.metrics), ("--mesh", cfg.mesh)):
+        if value is not None:
+            raise SystemExit(
+                f"{flag} is not supported for 1D W-rules (the spacetime "
+                "route has no engine state to checkpoint/shard; use --ppm "
+                "for the artifact)")
+
+    width = cfg.width
+    if width % bitpack.WORD:
+        raise SystemExit(
+            f"elementary rules run bit-packed: width {width} must be a "
+            f"multiple of {bitpack.WORD} (use --grid 1x{width + bitpack.WORD - width % bitpack.WORD})")
+    row = np.zeros(width, dtype=np.uint8)
+    if cfg.random_fill is not None:                 # --seed random
+        row[:] = np.random.default_rng(cfg.rng_seed).random(width) < cfg.random_fill
+    elif args.seed in ("glider", "center"):
+        # 'glider' is only the 2D default the parser injects; 1D's
+        # canonical single-cell seed takes its place (rule 90 from one
+        # cell -> the Sierpinski triangle)
+        row[width // 2] = 1
+    elif args.seed != "empty":
+        raise SystemExit(
+            f"--seed {args.seed!r} is a 2D seed; 1D W-rules accept "
+            "'center' (default), 'random', or 'empty'")
+
+    st = evolve_spacetime(
+        bitpack.pack(jnp.asarray(row[None])), cfg.steps, rule=rule,
+        topology=Topology(cfg.topology))
+    image = np.asarray(bitpack.unpack(st[:, 0, :]))  # (steps+1, W), row=time
+
+    if args.render in ("final", "live"):
+        for line in image:
+            print("".join(".#"[v] for v in line))
+    if cfg.track_population:
+        print(f"gen {cfg.steps}  pop {int(image[-1].sum())}")
+    if cfg.ppm:
+        from .utils.render import save_ppm
+
+        save_ppm(image, cfg.ppm)
+        print(f"spacetime diagram written: {cfg.ppm}", file=sys.stderr)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from .utils.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
     cfg, args = from_args(argv)
+
+    from .models.elementary import ElementaryRule
+    from .models.generations import parse_any
+
+    # --resume wins over --rule (documented in the flag's help): a W-rule
+    # left on the command line must not silently replace a resumed 2D run
+    if cfg.resume is None and isinstance(parse_any(cfg.rule), ElementaryRule):
+        return _run_elementary(cfg, args, parse_any(cfg.rule))
+
     coordinator, scheduler = cfg.build()
 
     if args.render == "live":
@@ -40,6 +112,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # final rendering already show it in the status line)
         frame = coordinator.current_frame()
         print(f"gen {frame.generation}  pop {frame.population}")
+
+    if cfg.ppm:
+        import numpy as np
+
+        from .utils.render import save_ppm
+
+        save_ppm(np.asarray(coordinator.engine.snapshot()), cfg.ppm)
+        print(f"final frame written: {cfg.ppm}", file=sys.stderr)
 
     if cfg.checkpoint:
         from .utils import checkpoint as ckpt_lib

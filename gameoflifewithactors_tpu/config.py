@@ -43,6 +43,7 @@ class SimulationConfig:
     track_population: bool = False
     checkpoint: Optional[str] = None        # save path (written at end)
     resume: Optional[str] = None            # checkpoint to resume from
+    ppm: Optional[str] = None               # final-frame / spacetime PPM path
 
     # -- assembly ------------------------------------------------------------
 
@@ -197,6 +198,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--population", action="store_true", help="track live-cell count")
     p.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="write final state here")
+    p.add_argument("--ppm", default=None, metavar="PATH",
+                   help="write the final grid (2D rules) or the full "
+                        "spacetime diagram (1D W-rules) as a PPM image")
     p.add_argument("--resume", default=None, metavar="PATH",
                    help="resume from a checkpoint (the checkpoint's grid/rule/"
                         "seed/topology win; --grid/--rule/--seed/--topology are ignored)")
@@ -229,5 +233,6 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         track_population=args.population,
         checkpoint=args.checkpoint,
         resume=args.resume,
+        ppm=args.ppm,
     )
     return cfg, args

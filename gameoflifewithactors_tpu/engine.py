@@ -101,7 +101,9 @@ class Engine:
         if isinstance(self.rule, ElementaryRule):
             raise ValueError(
                 f"{self.rule.notation} is a 1D (elementary) rule; the Engine "
-                "drives 2D grids. Use ops.elementary directly: "
+                "drives 2D grids. Use the CLI spacetime route "
+                f"(python -m gameoflifewithactors_tpu --rule {self.rule.notation} "
+                "--render final), or ops.elementary directly: "
                 "multi_step_elementary / evolve_spacetime on a packed row "
                 "(see examples/wolfram.py)")
         self._generations = isinstance(self.rule, GenRule)
@@ -202,6 +204,7 @@ class Engine:
             self.backend = backend = "dense"
         self._sparse = None
         self._flags = None
+        self._sparse_tiles = None
         if mesh is not None:
             # validate in *cell* units before packing, so the error names the
             # user's grid shape, not the packed word shape
@@ -223,10 +226,11 @@ class Engine:
             state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
-            if backend == "sparse" and sparse_opts:
+            if (backend == "sparse" and sparse_opts
+                    and (self._generations or not self._packed)):
                 warnings.warn(
-                    "sparse_opts (tile_rows/tile_words/capacity) apply to "
-                    "the single-device sparse engine only; the sharded "
+                    "sparse_opts apply to the binary tiled sharded path and "
+                    "the single-device engine; the sharded Generations "
                     "sparse path skips at per-device granularity and "
                     "ignores them",
                     stacklevel=3,
@@ -270,10 +274,34 @@ class Engine:
                     self._run = sharded.make_multi_step_generations(
                         mesh, self.rule, topology, donate=True)
             elif backend == "sparse":
-                # per-device activity skipping: flags ride along with state
-                self._run = self._flagged_sparse_runner(
-                    sharded.make_multi_step_packed_sparse(
-                        mesh, self.rule, topology, donate=True), mesh)
+                # PER-TILE activity skipping inside each shard (VERDICT
+                # round-2 item #5): the single-device engine's tiling
+                # composed under shard_map — a mostly-empty 65536² gun
+                # sharded over N devices sleeps at tile, not device,
+                # granularity. Tile dims auto-fit the LOCAL shard
+                # (ops.sparse.auto_tile guarantees divisibility);
+                # sparse_opts tile_rows/tile_words/capacity override.
+                from .ops import sparse as sparse_ops
+
+                opts = dict(sparse_opts or {})
+                local_h = self.shape[0] // nx
+                local_w = self.shape[1] // bitpack.WORD // ny
+                auto_tr, auto_tw = sparse_ops.auto_tile(local_h, local_w)
+                tr = opts.get("tile_rows", auto_tr)
+                tw = opts.get("tile_words", auto_tw)
+                if local_h % tr or local_w % tw:
+                    raise ValueError(
+                        f"per-device shard {local_h}x{local_w * bitpack.WORD} "
+                        f"cells not divisible into sparse tiles of "
+                        f"{tr}x{tw * bitpack.WORD} cells; pick sparse tile "
+                        "dims that divide the shard (or omit them to "
+                        "auto-tile)")
+                self._run = self._tiled_sparse_runner(
+                    sharded.make_multi_step_packed_sparse_tiled(
+                        mesh, self.rule, topology, tile_rows=tr,
+                        tile_words=tw, capacity=opts.get("capacity"),
+                        donate=True),
+                    mesh, tr, tw, state)
             elif backend == "pallas":
                 # row-band native kernel: exchange a depth-g halo, advance g
                 # gens in the Mosaic slab kernel, crop (parallel/sharded.py
@@ -412,6 +440,21 @@ class Engine:
         plane stack — both return ``(state, flags)``) so the per-device
         activity flags ride along with the engine state."""
         self._flags = sharded.initial_flags(mesh)
+
+        def _run(s, n):
+            s, self._flags = run2(s, self._flags, n)
+            return s
+
+        return _run
+
+    def _tiled_sparse_runner(self, run2, mesh: Mesh, tile_rows: int,
+                             tile_words: int, state):
+        """Like :meth:`_flagged_sparse_runner`, but the flags are the
+        per-shard TILE activity map (one uint32 per tile, sharded like the
+        grid) seeded from the initial state's live tiles."""
+        self._sparse_tiles = (tile_rows, tile_words)
+        self._flags = sharded.initial_tile_activity(
+            state, mesh, tile_rows, tile_words)
 
         def _run(s, n):
             s, self._flags = run2(s, self._flags, n)
@@ -583,9 +626,13 @@ class Engine:
             # this is an estimate, and bulk stepping dominates)
             total = -(-total // g)  # ceil
         if self._flags is not None:
-            # sharded sparse also halo-exchanges the (1,1) uint32 activity
-            # flag: 4-byte row strips, 12-byte (3,1) column strips
-            total += row_sends * 4 + col_sends * 12
+            # sharded sparse also halo-exchanges its uint32 activity map:
+            # per-device (1, 1) flags cost 4-byte row / 12-byte col strips;
+            # the tiled map's strips scale with the local tile-map dims
+            fy, fx = (self._flags.shape
+                      if getattr(self, "_sparse_tiles", None) else (nx, ny))
+            total += (row_sends * (fx // ny) * 4
+                      + col_sends * (fy // nx + 2) * 4)
         return total
 
     def population(self) -> int:
@@ -636,7 +683,12 @@ class Engine:
         else:
             self._state = state
         if self._flags is not None:
-            self._flags = sharded.initial_flags(self.mesh)  # wake every tile
+            if getattr(self, "_sparse_tiles", None):
+                tr, tw = self._sparse_tiles  # re-seed from the new grid
+                self._flags = sharded.initial_tile_activity(
+                    state, self.mesh, tr, tw)
+            else:
+                self._flags = sharded.initial_flags(self.mesh)  # wake all
         if generation is not None:
             self.generation = generation
 

@@ -101,13 +101,18 @@ def _tile_grid_shape(H: int, Wp: int, tile_rows: int, tile_words: int) -> Tuple[
     return H // tile_rows, Wp // tile_words
 
 
+def tile_activity(packed: jax.Array, tile_rows: int, tile_words: int) -> jax.Array:
+    """Per-tile any-live map of an UNPADDED packed grid — no full-grid
+    padded temporary (at 65536² that copy is ~512 MB)."""
+    H, Wp = packed.shape[-2:]
+    nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
+    tiles = packed.reshape(*packed.shape[:-2], nty, tile_rows, ntx, tile_words)
+    return (tiles != 0).any(axis=tuple(range(packed.ndim - 2)) + (-3, -1))
+
+
 def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int) -> jax.Array:
     """All tiles containing any live cell are initially 'changed'."""
-    interior = padded[..., 1:-1, 1:-1]
-    H, Wp = interior.shape[-2:]
-    nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
-    tiles = interior.reshape(*interior.shape[:-2], nty, tile_rows, ntx, tile_words)
-    return (tiles != 0).any(axis=tuple(range(interior.ndim - 2)) + (-3, -1))
+    return tile_activity(padded[..., 1:-1, 1:-1], tile_rows, tile_words)
 
 
 def _dilate(active: jax.Array, wrap: bool = False) -> jax.Array:

@@ -153,6 +153,112 @@ def _make_flagged_sparse(mesh, state_spec, exchange, step_ext, topology,
     return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
 
 
+def initial_tile_activity(packed: jax.Array, mesh: Mesh, tile_rows: int,
+                          tile_words: int) -> jax.Array:
+    """The global (H/tile_rows, Wp/tile_words) changed-flag map for
+    :func:`make_multi_step_packed_sparse_tiled`, sharded over ``mesh`` like
+    the grid: every tile containing a live cell starts 'changed'. uint32
+    0/1 (the map makes ppermute halo trips)."""
+    from jax.sharding import NamedSharding
+
+    from ..ops import sparse as sparse_ops
+
+    act = sparse_ops.tile_activity(packed, tile_rows, tile_words).astype(jnp.uint32)
+    return jax.device_put(act, NamedSharding(mesh, _SPEC))
+
+
+def make_multi_step_packed_sparse_tiled(
+    mesh: Mesh,
+    rule: Rule,
+    topology: Topology = Topology.TORUS,
+    *,
+    tile_rows: int,
+    tile_words: int,
+    capacity: int | None = None,
+    donate: bool = False,
+) -> Callable:
+    """Sharded stepping with PER-TILE activity skipping inside every shard.
+
+    VERDICT round-2 item #5: :func:`make_multi_step_packed_sparse` skips at
+    whole-device granularity, so a 65536² gun sharded over 8 devices keeps
+    ~all devices awake. This runner composes the single-device engine's
+    activity tiling (ops/sparse.py) *within* each device's shard: per
+    generation each device
+
+    1. halo-exchanges its grid tile (unconditional — collectives need every
+       device) and a 1-tile-deep halo of its LOCAL activity map (a
+       neighbor's edge-tile change must wake this device's edge tile);
+    2. dilates the extended map into the candidate set (exact for 3×3
+       rules: a tile can only change if its 3×3 tile-neighborhood did);
+    3. gathers a static ``capacity`` of candidate windows, steps them as a
+       vmapped batch, scatters the interiors back (the mirror of
+       ops/sparse.py sparse_gen with the halo-extended shard as the padded
+       grid); a device whose candidate count exceeds capacity takes one
+       whole-shard dense generation instead (``lax.cond`` — per-device,
+       collective-free branches, so sleepy devices stay cheap while a hot
+       device overflows safely).
+
+    ``tile_rows``/``tile_words`` are per-shard tile dims (use
+    ops.sparse.auto_tile on the LOCAL shard shape); ``capacity`` defaults
+    to a quarter of the local tile count, clamped to [32, 1024].
+
+    Returns jitted ``(grid, act, n) -> (grid, act)``; ``act`` is the
+    sharded global tile map from :func:`initial_tile_activity`.
+    """
+    from ..ops.sparse import _dilate
+
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+
+    def gen(tile, act):
+        h, w = tile.shape
+        nty, ntx = h // tile_rows, w // tile_words
+        cap = capacity or max(32, min(1024, (nty * ntx) // 4 or 32))
+        ext = exchange_halo(tile, nx, ny, topology)
+        aext = exchange_halo(act, nx, ny, topology)
+        cand = _dilate(aext.astype(bool), wrap=False)[1:-1, 1:-1]
+        n_cand = jnp.sum(cand)
+
+        def sparse_branch(_):
+            idx = jnp.nonzero(cand.ravel(), size=cap, fill_value=0)[0]
+            valid = jnp.arange(cap) < n_cand
+            tys, txs = idx // ntx, idx % ntx
+            windows = jax.vmap(lambda ty, tx: jax.lax.dynamic_slice(
+                ext, (ty * tile_rows, tx * tile_words),
+                (tile_rows + 2, tile_words + 2)))(tys, txs)
+            stepped = jax.vmap(
+                lambda win: packed_ops.step_packed_ext(win, rule))(windows)
+            olds = windows[:, 1:-1, 1:-1]
+            changed = jnp.logical_and(
+                (stepped != olds).any(axis=(1, 2)), valid)
+            # one batched scatter; fill slots routed out of bounds (drop)
+            row0 = jnp.where(valid, tys * tile_rows + 1, h + 2)
+            col0 = jnp.where(valid, txs * tile_words + 1, w + 2)
+            rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
+            cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
+            new_ext = ext.at[rows, cols].set(stepped, mode="drop",
+                                             unique_indices=True)
+            new_act = jnp.zeros((nty, ntx), jnp.uint32)
+            new_act = new_act.at[jnp.where(valid, tys, nty),
+                                 jnp.where(valid, txs, ntx)].set(
+                changed.astype(jnp.uint32), mode="drop", unique_indices=True)
+            return new_ext[1:-1, 1:-1], new_act
+
+        def dense_branch(_):
+            new = packed_ops.step_packed_ext(ext, rule)
+            t_old = tile.reshape(nty, tile_rows, ntx, tile_words)
+            t_new = new.reshape(nty, tile_rows, ntx, tile_words)
+            return new, (t_old != t_new).any(axis=(1, 3)).astype(jnp.uint32)
+
+        return jax.lax.cond(n_cand <= cap, sparse_branch, dense_branch, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, _SPEC, P()),
+             out_specs=(_SPEC, _SPEC))
+    def _run(tile, act, n):
+        return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (tile, act))
+
+    return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
+
+
 def make_multi_step_packed_deep(
     mesh: Mesh,
     rule: Rule,

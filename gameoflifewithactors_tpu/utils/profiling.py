@@ -149,6 +149,12 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = sharded.make_multi_step_generations(
             engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_sparse_tiles", None):
+        tr, tw = engine._sparse_tiles
+        step1 = sharded.make_multi_step_packed_sparse_tiled(
+            engine.mesh, engine.rule, engine.topology,
+            tile_rows=tr, tile_words=tw)
+        lowered = step1.lower(engine.state, engine._flags, 1)
     elif engine._flags is not None:
         step1 = sharded.make_multi_step_packed_sparse(
             engine.mesh, engine.rule, engine.topology)
