@@ -226,15 +226,31 @@ class Engine:
             state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
-            if (backend == "sparse" and sparse_opts
-                    and (self._generations or not self._packed)):
-                warnings.warn(
-                    "sparse_opts apply to the binary tiled sharded path and "
-                    "the single-device engine; the sharded Generations "
-                    "sparse path skips at per-device granularity and "
-                    "ignores them",
-                    stacklevel=3,
-                )
+            def _tiled_sparse(make):
+                # shared tile-dim resolution for the per-tile sharded
+                # sparse runners (binary bitboard / Generations stack):
+                # auto-fit the LOCAL shard, honor sparse_opts overrides,
+                # validate divisibility with a clear error
+                from .ops import sparse as sparse_ops
+
+                opts = dict(sparse_opts or {})
+                local_h = self.shape[0] // nx
+                local_w = self.shape[1] // bitpack.WORD // ny
+                auto_tr, auto_tw = sparse_ops.auto_tile(local_h, local_w)
+                tr = opts.get("tile_rows", auto_tr)
+                tw = opts.get("tile_words", auto_tw)
+                if local_h % tr or local_w % tw:
+                    raise ValueError(
+                        f"per-device shard {local_h}x"
+                        f"{local_w * bitpack.WORD} cells not divisible "
+                        f"into sparse tiles of {tr}x{tw * bitpack.WORD} "
+                        "cells; pick sparse tile dims that divide the "
+                        "shard (or omit them to auto-tile)")
+                return self._tiled_sparse_runner(
+                    make(mesh, self.rule, topology, tile_rows=tr,
+                         tile_words=tw, capacity=opts.get("capacity"),
+                         donate=True),
+                    mesh, tr, tw, state)
             if self._ltl:
                 r = self.rule.radius
                 if self.shape[0] // nx < r or self.shape[1] // ny < r:
@@ -251,9 +267,9 @@ class Engine:
                         mesh, self.rule, topology, donate=True)
             elif self._generations:
                 if backend == "sparse":
-                    self._run = self._flagged_sparse_runner(
-                        sharded.make_multi_step_generations_packed_sparse(
-                            mesh, self.rule, topology, donate=True), mesh)
+                    # per-tile skipping inside each shard, plane-stack form
+                    self._run = _tiled_sparse(
+                        sharded.make_multi_step_generations_packed_sparse_tiled)
                 elif self._gen_packed and backend == "pallas":
                     # row-band native kernel over the plane stack; n % g
                     # remainders take the per-gen sharded plane runner
@@ -278,30 +294,9 @@ class Engine:
                 # round-2 item #5): the single-device engine's tiling
                 # composed under shard_map — a mostly-empty 65536² gun
                 # sharded over N devices sleeps at tile, not device,
-                # granularity. Tile dims auto-fit the LOCAL shard
-                # (ops.sparse.auto_tile guarantees divisibility);
-                # sparse_opts tile_rows/tile_words/capacity override.
-                from .ops import sparse as sparse_ops
-
-                opts = dict(sparse_opts or {})
-                local_h = self.shape[0] // nx
-                local_w = self.shape[1] // bitpack.WORD // ny
-                auto_tr, auto_tw = sparse_ops.auto_tile(local_h, local_w)
-                tr = opts.get("tile_rows", auto_tr)
-                tw = opts.get("tile_words", auto_tw)
-                if local_h % tr or local_w % tw:
-                    raise ValueError(
-                        f"per-device shard {local_h}x{local_w * bitpack.WORD} "
-                        f"cells not divisible into sparse tiles of "
-                        f"{tr}x{tw * bitpack.WORD} cells; pick sparse tile "
-                        "dims that divide the shard (or omit them to "
-                        "auto-tile)")
-                self._run = self._tiled_sparse_runner(
-                    sharded.make_multi_step_packed_sparse_tiled(
-                        mesh, self.rule, topology, tile_rows=tr,
-                        tile_words=tw, capacity=opts.get("capacity"),
-                        donate=True),
-                    mesh, tr, tw, state)
+                # granularity.
+                self._run = _tiled_sparse(
+                    sharded.make_multi_step_packed_sparse_tiled)
             elif backend == "pallas":
                 # row-band native kernel: exchange a depth-g halo, advance g
                 # gens in the Mosaic slab kernel, crop (parallel/sharded.py

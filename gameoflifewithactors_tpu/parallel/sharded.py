@@ -205,15 +205,60 @@ def make_multi_step_packed_sparse_tiled(
     Returns jitted ``(grid, act, n) -> (grid, act)``; ``act`` is the
     sharded global tile map from :func:`initial_tile_activity`.
     """
-    from ..ops.sparse import _dilate
+    return _make_tiled_sparse(
+        mesh, rule, topology, _SPEC,
+        lambda s, nx, ny: exchange_halo(s, nx, ny, topology),
+        tile_rows, tile_words, capacity, donate)
 
+
+def make_multi_step_generations_packed_sparse_tiled(
+    mesh: Mesh,
+    rule,
+    topology: Topology = Topology.TORUS,
+    *,
+    tile_rows: int,
+    tile_words: int,
+    capacity: int | None = None,
+    donate: bool = False,
+) -> Callable:
+    """Per-tile sharded sparse for the Generations (b, H, W/32) plane
+    stack: the multi-state twin of
+    :func:`make_multi_step_packed_sparse_tiled` (same activity-map halo
+    trip and candidate gather/step/scatter; windows carry all b planes,
+    ONE stacked ppermute trip per generation). Decaying tiles keep
+    themselves awake by changing, so the 3×3 wake rule stays exact.
+    Returns jitted ``(planes, act, n) -> (planes, act)``."""
+    return _make_tiled_sparse(
+        mesh, rule, topology, P(None, ROW_AXIS, COL_AXIS),
+        lambda s, nx, ny: exchange_halo_stack(s, nx, ny, topology),
+        tile_rows, tile_words, capacity, donate)
+
+
+def _make_tiled_sparse(mesh, rule, topology, state_spec, exchange,
+                       tile_rows, tile_words, capacity, donate):
+    """Shared per-tile sharded sparse builder for both layouts: the state
+    is (h, w) or (b, h, w) per shard; the activity map is always the 2D
+    local tile map. ops.sparse._step_window dispatches the stencil by
+    ndim, so the two layouts differ only in halo exchange and the plane
+    axis of the scatter (the mirror of ops/sparse.py's ``lead`` handling).
+    """
+    from ..ops.sparse import _dilate, _step_window
+
+    if 0 in rule.born:
+        # same contract as the single-device SparseEngineState: under B0
+        # every quiescent region births cells each generation, so a tile
+        # seeded asleep (no live cells) would immediately be wrong
+        raise ValueError(
+            f"sparse backends cannot run B0 rules ({rule.notation}): "
+            "nothing ever sleeps — use the packed backend")
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
-    def gen(tile, act):
-        h, w = tile.shape
+    def gen(state, act):
+        lead = state.shape[:-2]
+        h, w = state.shape[-2:]
         nty, ntx = h // tile_rows, w // tile_words
         cap = capacity or max(32, min(1024, (nty * ntx) // 4 or 32))
-        ext = exchange_halo(tile, nx, ny, topology)
+        ext = exchange(state, nx, ny)
         aext = exchange_halo(act, nx, ny, topology)
         cand = _dilate(aext.astype(bool), wrap=False)[1:-1, 1:-1]
         n_cand = jnp.sum(cand)
@@ -223,38 +268,47 @@ def make_multi_step_packed_sparse_tiled(
             valid = jnp.arange(cap) < n_cand
             tys, txs = idx // ntx, idx % ntx
             windows = jax.vmap(lambda ty, tx: jax.lax.dynamic_slice(
-                ext, (ty * tile_rows, tx * tile_words),
-                (tile_rows + 2, tile_words + 2)))(tys, txs)
-            stepped = jax.vmap(
-                lambda win: packed_ops.step_packed_ext(win, rule))(windows)
-            olds = windows[:, 1:-1, 1:-1]
+                ext, (0,) * len(lead) + (ty * tile_rows, tx * tile_words),
+                lead + (tile_rows + 2, tile_words + 2)))(tys, txs)
+            stepped = jax.vmap(lambda win: _step_window(win, rule))(windows)
+            olds = windows[..., 1:-1, 1:-1]
             changed = jnp.logical_and(
-                (stepped != olds).any(axis=(1, 2)), valid)
+                (stepped != olds).any(axis=tuple(range(1, stepped.ndim))),
+                valid)
             # one batched scatter; fill slots routed out of bounds (drop)
             row0 = jnp.where(valid, tys * tile_rows + 1, h + 2)
             col0 = jnp.where(valid, txs * tile_words + 1, w + 2)
             rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
             cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
-            new_ext = ext.at[rows, cols].set(stepped, mode="drop",
-                                             unique_indices=True)
+            if lead:
+                # (K, b, tr, tw) -> (b, K, tr, tw): one spatial scatter
+                # shared by every plane of the stack
+                new_ext = ext.at[:, rows, cols].set(
+                    jnp.moveaxis(stepped, 1, 0), mode="drop",
+                    unique_indices=True)
+            else:
+                new_ext = ext.at[rows, cols].set(stepped, mode="drop",
+                                                 unique_indices=True)
             new_act = jnp.zeros((nty, ntx), jnp.uint32)
             new_act = new_act.at[jnp.where(valid, tys, nty),
                                  jnp.where(valid, txs, ntx)].set(
                 changed.astype(jnp.uint32), mode="drop", unique_indices=True)
-            return new_ext[1:-1, 1:-1], new_act
+            return new_ext[..., 1:-1, 1:-1], new_act
 
         def dense_branch(_):
-            new = packed_ops.step_packed_ext(ext, rule)
-            t_old = tile.reshape(nty, tile_rows, ntx, tile_words)
-            t_new = new.reshape(nty, tile_rows, ntx, tile_words)
-            return new, (t_old != t_new).any(axis=(1, 3)).astype(jnp.uint32)
+            new = _step_window(ext, rule)
+            t_old = state.reshape(*lead, nty, tile_rows, ntx, tile_words)
+            t_new = new.reshape(*lead, nty, tile_rows, ntx, tile_words)
+            changed = (t_old != t_new).any(
+                axis=tuple(range(len(lead))) + (-3, -1))
+            return new, changed.astype(jnp.uint32)
 
         return jax.lax.cond(n_cand <= cap, sparse_branch, dense_branch, None)
 
-    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, _SPEC, P()),
-             out_specs=(_SPEC, _SPEC))
-    def _run(tile, act, n):
-        return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (tile, act))
+    @partial(shard_map, mesh=mesh, in_specs=(state_spec, _SPEC, P()),
+             out_specs=(state_spec, _SPEC))
+    def _run(state, act, n):
+        return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (state, act))
 
     return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
 

@@ -141,6 +141,16 @@ def measured_halo_bytes_per_gen(engine) -> int:
     elif getattr(engine, "_ltl", False):
         step1 = sharded.make_multi_step_ltl(engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_sparse_tiles", None):
+        # per-tile sharded sparse (either layout): the flag-map halo rides
+        # along, so lower the same runner the engine steps with
+        tr, tw = engine._sparse_tiles
+        make = (sharded.make_multi_step_generations_packed_sparse_tiled
+                if getattr(engine, "_gen_packed", False)
+                else sharded.make_multi_step_packed_sparse_tiled)
+        step1 = make(engine.mesh, engine.rule, engine.topology,
+                     tile_rows=tr, tile_words=tw)
+        lowered = step1.lower(engine.state, engine._flags, 1)
     elif getattr(engine, "_gen_packed", False):
         step1 = sharded.make_multi_step_generations_packed(
             engine.mesh, engine.rule, engine.topology)
@@ -149,12 +159,6 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = sharded.make_multi_step_generations(
             engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)
-    elif getattr(engine, "_sparse_tiles", None):
-        tr, tw = engine._sparse_tiles
-        step1 = sharded.make_multi_step_packed_sparse_tiled(
-            engine.mesh, engine.rule, engine.topology,
-            tile_rows=tr, tile_words=tw)
-        lowered = step1.lower(engine.state, engine._flags, 1)
     elif engine._flags is not None:
         step1 = sharded.make_multi_step_packed_sparse(
             engine.mesh, engine.rule, engine.topology)

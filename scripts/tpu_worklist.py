@@ -19,6 +19,8 @@ Items:
   ltl_bosco         LtL: on-chip identity vs CPU + dense and bit-sliced rates
   generations_brain Generations path: on-chip bit-identity vs CPU + rate
   ltl_lowering      compiled-HLO evidence the LtL step lowers conv-free (VPU tree)
+  sparse_tiled      per-tile sharded sparse: native identity + 16384² gun rate
+  elementary        1D Wolfram family: numpy-oracle identity + ensemble rate
   config5_sparse    65536² Gosper gun sparse on the chip
 """
 
@@ -38,6 +40,10 @@ for _p in (_REPO, os.path.dirname(os.path.abspath(__file__))):
 
 OUT_PATH = os.path.join(_REPO, "results", "tpu_worklist.json")
 WATCHDOG_S = float(os.environ.get("WORKLIST_WATCHDOG_S", "600"))
+# WORKLIST_SMOKE=1 shrinks the rate sections of the newer children so a
+# CPU run can validate their logic in seconds (tests use this); the
+# identity sections always run full.
+_SMOKE = os.environ.get("WORKLIST_SMOKE") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -442,6 +448,121 @@ def child_profile_trace() -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def child_sparse_tiled() -> dict:
+    """Per-tile sharded sparse (parallel/sharded.py
+    make_multi_step_packed_sparse_tiled, round-3 feature) on a (1, 1) mesh
+    over the real chip: native bit-identity vs the XLA packed path on a
+    gun universe, then the config-#5-shaped rate at 16384² (gens/s with
+    the activity map staying sparse)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models import seeds
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.sparse import auto_tile
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.parallel import sharded
+
+    m = mesh_lib.make_mesh((1, 1), jax.devices()[:1])
+    out = {"platform": jax.devices()[0].platform, "cases": []}
+    # identity: gun + soup patch, both topologies
+    ih, iw, igens = (256, 1024, 24) if _SMOKE else (1024, 4096, 64)
+    for topo in (Topology.TORUS, Topology.DEAD):
+        grid = np.asarray(seeds.seeded((ih, iw), "gosper_gun",
+                                       ih // 4, iw // 4))
+        p = bitpack.pack(jnp.asarray(grid))
+        tr, tw = auto_tile(ih, iw // 32)
+        run = sharded.make_multi_step_packed_sparse_tiled(
+            m, CONWAY, topo, tile_rows=tr, tile_words=tw)
+        act = sharded.initial_tile_activity(p, m, tr, tw)
+        got, _ = run(mesh_lib.device_put_sharded_grid(p, m), act, igens)
+        want = multi_step_packed(p, igens, rule=CONWAY, topology=topo)
+        same = _device_equal(got, want)
+        out["cases"].append({"topology": topo.value, "bit_identical": same})
+        if not same:
+            out["ok"] = False
+            return out
+
+    # rate: 16384² mostly-empty gun (config-#5 shape at bench scale);
+    # seeded_packed keeps host work O(pattern), not O(grid)
+    side, gens = (2048, 64) if _SMOKE else (16384, 512)
+    p = jnp.asarray(seeds.seeded_packed(
+        (side, side), "gosper_gun", side // 2, side // 64))
+    tr, tw = auto_tile(side, side // 32)
+    run = sharded.make_multi_step_packed_sparse_tiled(
+        m, CONWAY, Topology.TORUS, tile_rows=tr, tile_words=tw, donate=True)
+    act = sharded.initial_tile_activity(p, m, tr, tw)
+    p = mesh_lib.device_put_sharded_grid(p, m)
+    p, act = run(p, act, 8)
+    _sync_scalar(act)
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        p, act = run(p, act, gens)
+        _sync_scalar(act)
+        best = max(best, gens / (time.perf_counter() - t0))
+    out["ok"] = True
+    out["gens_per_sec_16384_gun"] = best
+    out["active_tiles"] = int(jnp.sum(act))
+    out["tile_shape"] = [tr, tw]
+    return out
+
+
+def child_elementary() -> dict:
+    """Elementary (1D Wolfram) family natively: numpy brute-force oracle
+    for W30/W90/W110 on-chip, then the ensemble rate (8192 universes x
+    131072 cells) — the family's first on-chip number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.elementary import parse_elementary
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.elementary import multi_step_elementary
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    def oracle(row: "np.ndarray", number: int, n: int) -> "np.ndarray":
+        for _ in range(n):
+            l, r = np.roll(row, 1), np.roll(row, -1)
+            row = ((number >> ((l << 2) | (row << 1) | r)) & 1).astype(np.uint8)
+        return row
+
+    out = {"platform": jax.devices()[0].platform, "cases": []}
+    rng = np.random.default_rng(3)
+    for name in ("W30", "W90", "W110"):
+        rule = parse_elementary(name)
+        row = rng.integers(0, 2, size=256, dtype=np.uint8)
+        want = oracle(row.copy(), rule.number, 40)
+        got = bitpack.unpack(multi_step_elementary(
+            bitpack.pack(jnp.asarray(row[None])), 40, rule=rule,
+            topology=Topology.TORUS))[0]
+        same = bool(jnp.array_equal(got, jnp.asarray(want)))
+        out["cases"].append({"rule": name, "oracle_match": same})
+        if not same:
+            out["ok"] = False
+            return out
+
+    # ensemble rate: independent universes on the leading axis
+    H, W, gens = (256, 4096, 64) if _SMOKE else (8192, 131072, 512)
+    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(H, W // 32), dtype=np.uint32))
+    rule = parse_elementary("W30")
+    p = multi_step_elementary(p, 8, rule=rule)
+    _sync_scalar(p)
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        p = multi_step_elementary(p, gens, rule=rule)
+        _sync_scalar(p)
+        best = max(best, H * W * gens / (time.perf_counter() - t0))
+    out["ok"] = True
+    out["cell_updates_per_sec"] = best
+    return out
+
+
 def child_config5_sparse() -> dict:
     out_path = os.path.join(_REPO, "results", "config5_sparse_65536_tpu.json")
     r = subprocess.run(
@@ -465,6 +586,8 @@ ITEMS = {
     "pallas_band": child_pallas_band,
     "pallas_generations": child_pallas_generations,
     "profile_trace": child_profile_trace,
+    "sparse_tiled": child_sparse_tiled,
+    "elementary": child_elementary,
     "config5_sparse": child_config5_sparse,
 }
 

@@ -124,6 +124,36 @@ def test_bench_config_key_uses_requested_size():
     assert bench._config_key(a) != bench._config_key(b)
 
 
+def test_worklist_children_smoke_cpu():
+    """The round-3 worklist children (sparse_tiled, elementary) validated
+    end-to-end on CPU at WORKLIST_SMOKE=1 scale — a regression (bad
+    import, shape bug) must surface here, not on the next healthy tunnel
+    window."""
+    import json
+    import os
+    import sys
+
+    import axon_guard
+
+    # children must not see the axon plugin path: its sitecustomize imports
+    # jax at interpreter startup and a wedged tunnel hangs the discovery
+    # (the same reason bench.py strips it for its CPU fallback child)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "WORKLIST_SMOKE": "1",
+           "PYTHONPATH": axon_guard.strip_pythonpath()}
+    for item in ("sparse_tiled", "elementary"):
+        r = subprocess.run(
+            [sys.executable, "scripts/tpu_worklist.py", "--item", item],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        line = next((ln for ln in reversed(r.stdout.strip().splitlines())
+                     if ln.startswith("{")), None)
+        assert r.returncode == 0 and line, (item, r.stderr[-600:])
+        d = json.loads(line)
+        assert d.get("ok") is True, (item, d)
+        assert all(c.get("bit_identical", c.get("oracle_match"))
+                   for c in d["cases"]), (item, d["cases"])
+
+
 def test_weak_scaling_script_end_to_end():
     # VERDICT round-1 #8: the harness must be proven runnable; tiny config
     r = subprocess.run(

@@ -282,6 +282,46 @@ class TestTiledShardedSparse:
         want = multi_step_packed(p, 12, rule=CONWAY, topology=Topology.TORUS)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
 
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    def test_generations_tiled_bit_identity(self, topology):
+        """Plane-stack twin: a decaying Brain blob over the tile map."""
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            multi_step_packed_generations,
+            pack_generations_for,
+        )
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+        from gameoflifewithactors_tpu.parallel import sharded
+
+        rule = parse_any("brain")
+        m = self._mesh((2, 4))
+        grid = np.zeros((64, 256), np.uint8)
+        grid[20:24, 60:66] = 2
+        grid[21, 61] = 1
+        planes = pack_generations_for(jnp.asarray(grid), rule)
+        want = np.asarray(multi_step_packed_generations(
+            planes, 24, rule=rule, topology=topology))
+        run = sharded.make_multi_step_generations_packed_sparse_tiled(
+            m, rule, topology, tile_rows=16, tile_words=1)
+        act = sharded.initial_tile_activity(planes, m, 16, 1)
+        out, act = run(mesh_lib.device_put_sharded_grid(planes, m), act, 24)
+        np.testing.assert_array_equal(np.asarray(out), want)
+        # the map stays sparse; under DEAD the blob may burn out entirely
+        # (everything asleep) — under TORUS something survives the wrap
+        f = np.asarray(act)
+        assert f.sum() <= f.size // 2
+
+    def test_b0_rule_rejected(self):
+        from gameoflifewithactors_tpu.models.rules import parse_rule
+        from gameoflifewithactors_tpu.parallel import sharded
+
+        with pytest.raises(ValueError, match="B0"):
+            sharded.make_multi_step_packed_sparse_tiled(
+                self._mesh((2, 2)), parse_rule("B0/S8"), Topology.TORUS,
+                tile_rows=8, tile_words=1)
+
     def test_engine_facade_tiled_sparse(self):
         from gameoflifewithactors_tpu import Engine
         from gameoflifewithactors_tpu.models import seeds
@@ -321,19 +361,15 @@ class TestTiledShardedSparse:
         from gameoflifewithactors_tpu.models import seeds
 
         m = self._mesh()
-        # binary sharded sparse honors sparse_opts now (tiled path): no
-        # "ignored" warning, and the capacity reaches the runner
+        # both sharded sparse layouts honor sparse_opts now (tiled paths):
+        # no "ignored" warning on either
         with w.catch_warnings(record=True) as caught:
             w.simplefilter("always")
             e = Engine(seeds.empty((64, 128)), "B3/S23", mesh=m,
                        backend="sparse", sparse_opts={"capacity": 99})
-        assert not any("ignores them" in str(c.message) for c in caught)
-        # the sharded Generations path still skips per-device and warns
-        with w.catch_warnings(record=True) as caught:
-            w.simplefilter("always")
             Engine(seeds.empty((64, 128)), "brain", mesh=m,
                    backend="sparse", sparse_opts={"capacity": 99})
-        assert any("ignores them" in str(c.message) for c in caught)
+        assert not any("ignores them" in str(c.message) for c in caught)
         # flag-map halo rides on top of the grid halo in the estimate:
         # 64x128 over (2, 4) auto-tiles to a (1, 1) local map, so the
         # strips match the per-device-flag constants (4 B rows, 12 B cols)
