@@ -549,6 +549,71 @@ def make_multi_step_generations_pallas(
     return jax.jit(_run, donate_argnums=(0,) if donate else ())
 
 
+def make_multi_step_elementary_sharded(
+    mesh: Mesh,
+    rule,
+    topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    donate: bool = False,
+) -> Callable:
+    """Sharded 1D (elementary Wolfram) stepping: context parallelism for
+    the family's "long context" — a huge row, or an ensemble of them.
+
+    Layout: (H, W/32) packed, rows = independent universes (pure data
+    parallelism over the mesh's row axis — zero communication), width
+    sharded over the column axis. Per chunk each device ppermutes ONE halo
+    word (32 cells) per side along the column axis, then advances
+    ``g = gens_per_exchange`` generations locally with open (DEAD) closure
+    at the slab ends: corruption creeps inward 1 cell per generation from
+    the cropped slab edge, so the 32-cell halo word absorbs it exactly for
+    g <= 32 — the 1D face of make_multi_step_packed_deep's horizontal
+    trick. Collectives drop from 2/generation to 2/chunk.
+
+    Global DEAD topology: the leftmost/rightmost devices' halo words are
+    permanently-dead exterior, re-zeroed before every in-slab generation
+    (a birth just outside the edge would otherwise feed back from the 2nd
+    generation on) — gated by the same runtime edge code the band kernels
+    use (halo.band_edge_code, column-axis form).
+
+    Returns jitted ``(grid, chunks) -> grid`` advancing ``chunks * g``
+    generations, sharded P('x', 'y').
+    """
+    from ..ops.elementary import step_elementary
+
+    g = int(gens_per_exchange)
+    if not 1 <= g <= 32:
+        raise ValueError(
+            f"gens_per_exchange must be in [1, 32] (the 32-cell halo word "
+            f"bounds how far edge corruption may creep), got {g}")
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+
+    def chunk(tile):
+        # one word per side along the column axis (corner phases don't
+        # exist in 1D; rows never talk to each other) — the same public
+        # trip the 2D runners use
+        ext = exchange_cols(tile, ny, topology)
+        if topology is Topology.DEAD:
+            code = band_edge_code(ny, axis=COL_AXIS)[0, 0]
+            cols = jax.lax.broadcasted_iota(jnp.int32, ext.shape, 1)
+            exterior = ((((code & 1) == 1) & (cols == 0))
+                        | (((code & 2) == 2) & (cols == ext.shape[1] - 1)))
+
+            def body(_, s):
+                s = jnp.where(exterior, jnp.uint32(0), s)
+                return step_elementary(s, rule=rule, topology=Topology.DEAD)
+        else:
+            def body(_, s):
+                return step_elementary(s, rule=rule, topology=Topology.DEAD)
+        ext = jax.lax.fori_loop(0, g, body, ext)
+        return ext[:, 1:-1]
+
+    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
+    def _run(tile, chunks):
+        return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
 def initial_flags(mesh: Mesh) -> jax.Array:
     """All-active (nx, ny) flag array, sharded one element per device."""
     from jax.sharding import NamedSharding
