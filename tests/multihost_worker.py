@@ -76,6 +76,44 @@ def main() -> None:
     want_band = np.asarray(multi_step_packed(
         jnp.asarray(bpacked), 40, rule=CONWAY, topology=Topology.TORUS))
     np.testing.assert_array_equal(got_band, want_band)
+
+    # per-tile sharded sparse across REAL process boundaries: the gun's
+    # activity map makes its own halo trip between processes, and tiles on
+    # the far processes stay asleep while staying bit-exact
+    from gameoflifewithactors_tpu.ops import sparse as sparse_ops
+
+    sgrid = seeds.seeded((64, 64 * n_procs), "gosper_gun", 10, 12)
+    spacked = bitpack.pack_np(sgrid)
+    tr, tw = 16, 1
+    srun = sharded.make_multi_step_packed_sparse_tiled(
+        mesh, CONWAY, Topology.TORUS, tile_rows=tr, tile_words=tw)
+    act_np = np.asarray(sparse_ops.tile_activity(
+        jnp.asarray(spacked), tr, tw).astype(jnp.uint32))
+    sout, sact = srun(multihost.put_global_grid(spacked, mesh),
+                      multihost.put_global_grid(act_np, mesh), 40)
+    want_sparse = np.asarray(multi_step_packed(
+        jnp.asarray(spacked), 40, rule=CONWAY, topology=Topology.TORUS))
+    np.testing.assert_array_equal(multihost.gather_global(sout), want_sparse)
+    n_awake = int(multihost.gather_global(sact).sum())
+    assert 0 < n_awake < act_np.size, n_awake  # gun corner only
+
+    # sharded elementary (rows DP x width CP) across processes: the halo
+    # word crosses the process boundary every chunk
+    from gameoflifewithactors_tpu.models.elementary import parse_elementary
+    from gameoflifewithactors_tpu.ops.elementary import multi_step_elementary
+
+    w110 = parse_elementary("W110")
+    erow = np.zeros((4, 64 * n_procs), np.uint8)
+    erow[:, ::7] = 1  # deterministic, same on every process
+    epacked = bitpack.pack_np(erow)
+    erun = sharded.make_multi_step_elementary_sharded(
+        mesh, w110, Topology.TORUS, gens_per_exchange=8)
+    eout = multihost.gather_global(
+        erun(multihost.put_global_grid(epacked, mesh), 3))
+    want_e = np.asarray(multi_step_elementary(
+        jnp.asarray(epacked), 24, rule=w110, topology=Topology.TORUS))
+    np.testing.assert_array_equal(eout, want_e)
+
     print(f"MULTIHOST-OK proc={pid}/{n_procs} devices={len(jax.devices())}",
           flush=True)
 
