@@ -211,10 +211,12 @@ def run_bench(args) -> None:
         # multi-state rules have a bit-plane packed path (~4x the dense
         # rate on CPU) when the width packs (32 cells/word)
         _route_rule(True, "bit-plane packed")
-    elif isinstance(rule, LtLRule) and args.backend != "dense":
+    elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse"):
         # LtL: bit-sliced packed path on TPU (or when explicitly requested),
         # byte path elsewhere (2.4x faster under CPU XLA — engine routing);
-        # diamond (von Neumann) rules are dense-only
+        # diamond (von Neumann) rules are dense-only. An explicit sparse
+        # request passes through (the activity-tiled engine serves Moore
+        # LtL; it raises its own clear error for diamond rules).
         _route_rule((explicitly_packed or platform == "tpu")
                     and rule.neighborhood == "M", "bit-sliced packed")
 

@@ -123,13 +123,19 @@ class Engine:
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
                 "3x3 binary rules, mesh + backend='pallas' for Generations)")
-        if self._ltl and backend in ("pallas", "sparse"):
+        if self._ltl and backend == "pallas":
             raise ValueError(
-                f"backend={backend!r} does not serve LtLRule rules "
-                f"({self.rule.notation}): LtL has neither a pallas kernel "
-                "nor a sparse engine (backend='packed' is the bit-sliced "
-                "bitboard; backend='dense' the byte layout)"
+                f"backend='pallas' does not serve LtLRule rules "
+                f"({self.rule.notation}): LtL has no pallas kernel "
+                "(backend='packed' is the bit-sliced bitboard; "
+                "backend='dense' the byte layout; backend='sparse' the "
+                "activity-tiled engine for Moore rules)"
             )
+        if self._ltl and backend == "sparse" and mesh is not None:
+            raise ValueError(
+                "sharded sparse serves life-like and Generations rules; "
+                f"LtL sparse ({self.rule.notation}) is single-device — "
+                "drop the mesh or use backend='packed'")
         self.topology = topology
         self.mesh = mesh
         self.backend = backend
@@ -148,8 +154,17 @@ class Engine:
         # checkpoint); sharded tiles exchange r-row + 1-word halos
         _ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
         _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
-        self._ltl_packed = (self._ltl and backend == "packed" and _packs
-                            and self.rule.neighborhood == "M")
+        # sparse LtL rides the same bit-sliced packed windows, so it
+        # shares the packed gate (Moore + word-divisible width)
+        self._ltl_packed = (self._ltl and backend in ("packed", "sparse")
+                            and _packs and self.rule.neighborhood == "M")
+        if self._ltl and backend == "sparse" and not self._ltl_packed:
+            # an explicit sparse request that sparse cannot serve must not
+            # silently become a dense run
+            raise ValueError(
+                f"sparse LtL needs a Moore rule and a width divisible by "
+                f"32, got {self.rule.notation} on {self.shape}; use "
+                "backend='dense'")
         if self._ltl and backend == "packed" and not self._ltl_packed:
             # the bit-sliced path can't serve this rule/shape (diamond
             # neighborhood, or width not sharding into whole words): fall

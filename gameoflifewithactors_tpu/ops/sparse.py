@@ -2,21 +2,24 @@
 
 BASELINE.json config #5 is a Gosper gun in a 65536² field — ~10² live
 tiles out of ~10⁵. A dense step pays the whole grid every generation; this
-engine keeps a per-tile *changed-last-generation* flag and steps only tiles
-whose 3×3 tile-neighborhood changed (GoL locality makes that exact: a cell
-can only change if something within distance 1 changed, so a tile can only
-change if it or a neighbor tile changed). Still lifes fall asleep; ships
-wake tiles as they travel.
+engine keeps a per-tile *changed-last-generation* flag and steps only
+tiles whose wake-neighborhood changed. Rule locality makes that exact: a
+cell can only change if something within its rule's influence radius r
+changed (r = 1 for the 3×3 families, rule.radius for LtL), so a tile can
+only change if a tile within ceil(r / tile_extent) tile rings did
+(_wake_dilation). Still lifes fall asleep; ships wake tiles as they
+travel.
 
 XLA-friendly by construction (SURVEY.md §8 stage 6: "per-tile activity
 flags … rather than a true sparse format, which stays XLA-friendly"):
 
-- state is the packed grid *with a one-word/one-row zero ring* (the DEAD
-  boundary is the ring itself, so edge tiles need no special-casing);
+- state is the packed grid *with an (r-row, rw-word) zero ring* sized by
+  the rule (_rule_halo; the DEAD boundary is the ring itself, so edge
+  tiles need no special-casing);
 - each generation gathers a **static capacity** of K candidate tiles with
   ``jnp.nonzero(..., size=K)`` (static shapes: no recompilation), steps
-  them as a vmapped batch of (T+2-row, Tw+2-word) windows, and scatters
-  the interiors back;
+  them as a vmapped batch of (T+2r-row, Tw+2rw-word) windows, and
+  scatters the interiors back;
 - if more than K tiles are active, the on-device loop exits early and the
   host dispatches one full-grid dense generation, then resumes sparse —
   correctness never depends on K (see _build_sparse_step for why this
@@ -25,7 +28,9 @@ flags … rather than a true sparse format, which stays XLA-friendly"):
 Single-device, both topologies: for DEAD the zero ring *is* the boundary;
 for TORUS the ring is refreshed with wrapped interior edges every
 generation and the activity dilation wraps (seam-crossing ships work).
-The sharded form lives in parallel/sharded.py.
+Serves life-like bitboards, Generations plane stacks, and Moore-box LtL
+(the bit-sliced packed window step). The sharded form lives in
+parallel/sharded.py.
 """
 
 from __future__ import annotations
@@ -42,9 +47,15 @@ from .stencil import Topology
 
 
 def _step_window(window, rule):
-    """One generation of a halo-extended window in either layout: a
-    (tr+2, tw+2) packed bitboard (binary rules) or a (b, tr+2, tw+2)
-    Generations bit-plane stack (leading plane axis)."""
+    """One generation of a halo-extended window in any layout: a
+    (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL Moore) or a
+    (b, tr+2, tw+2) Generations bit-plane stack (leading plane axis)."""
+    from ..models.ltl import LtLRule
+
+    if isinstance(rule, LtLRule):
+        from .packed_ltl import step_ltl_packed_ext
+
+        return step_ltl_packed_ext(window, rule)
     if window.ndim == 2:
         return step_packed_ext(window, rule)
     from .packed_generations import step_planes_ext
@@ -53,9 +64,42 @@ def _step_window(window, rule):
         tuple(window[i] for i in range(window.shape[0])), rule))
 
 
-def _pad_ring(packed):
-    """One-row/one-word zero ring around the SPATIAL dims only."""
-    return jnp.pad(packed, [(0, 0)] * (packed.ndim - 2) + [(1, 1), (1, 1)])
+def _wake_dilation(rule, tile_rows: int, tile_words: int) -> Tuple[int, int]:
+    """Wake radius in TILE units, (dy, dx): a rule's influence travels r
+    cells per generation, so a tile must wake when anything within
+    ceil(r / tile_extent) tile rings changed. The ONE definition shared by
+    the on-device candidate dilation and the host capacity estimator —
+    they must agree or adaptive escalation can under-provision."""
+    r, _ = _rule_halo(rule)
+    from . import bitpack
+
+    return -(-r // tile_rows), -(-r // (tile_words * bitpack.WORD))
+
+
+def _rule_halo(rule) -> Tuple[int, int]:
+    """The zero-ring depth a rule's windowed step needs: (rows, words).
+    3x3 families use (1, 1); radius-r LtL Moore uses (r, 1) — its packed
+    step reads r halo rows but only one 32-cell halo word (r <= 7)."""
+    from ..models.ltl import LtLRule
+
+    if isinstance(rule, LtLRule):
+        return rule.radius, 1
+    return 1, 1
+
+
+def _births_from_nothing(rule) -> bool:
+    """True when an all-dead neighborhood births a cell — the property
+    that makes activity tiling unsound (nothing ever sleeps)."""
+    from ..models.ltl import LtLRule
+
+    if isinstance(rule, LtLRule):
+        return rule.born[0] == 0  # interval [lo, hi] over the box count
+    return 0 in rule.born
+
+
+def _pad_ring(packed, r: int = 1, rw: int = 1):
+    """Depth-(r rows, rw words) zero ring around the SPATIAL dims only."""
+    return jnp.pad(packed, [(0, 0)] * (packed.ndim - 2) + [(r, r), (rw, rw)])
 
 DEFAULT_TILE_ROWS = 32
 DEFAULT_TILE_WORDS = 4
@@ -110,39 +154,52 @@ def tile_activity(packed: jax.Array, tile_rows: int, tile_words: int) -> jax.Arr
     return (tiles != 0).any(axis=tuple(range(packed.ndim - 2)) + (-3, -1))
 
 
-def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int) -> jax.Array:
+def initial_activity(padded: jax.Array, tile_rows: int, tile_words: int,
+                     r: int = 1, rw: int = 1) -> jax.Array:
     """All tiles containing any live cell are initially 'changed'."""
-    return tile_activity(padded[..., 1:-1, 1:-1], tile_rows, tile_words)
+    return tile_activity(padded[..., r:-r, rw:-rw], tile_rows, tile_words)
 
 
-def _dilate(active: jax.Array, wrap: bool = False) -> jax.Array:
-    """3×3 tile-neighborhood OR — which tiles must be stepped.
+def _dilate(active: jax.Array, wrap: bool = False, dy: int = 1,
+            dx: int = 1) -> jax.Array:
+    """(2dy+1)×(2dx+1) tile-neighborhood OR — which tiles must be stepped.
+    dy/dx > 1 serve radius-r rules whose influence can cross more than one
+    tile boundary per generation (dy = ceil(r / tile_rows), etc.).
 
     ``wrap`` makes the neighborhood toroidal: an edge tile's change wakes
     the opposite-edge tile (a glider crossing the seam must find its
     destination awake)."""
     a = active
-    if wrap:
-        a = a | jnp.roll(active, 1, 0) | jnp.roll(active, -1, 0)
-        a = a | jnp.roll(a, 1, 1) | jnp.roll(a, -1, 1)
-    else:
-        a = a | jnp.pad(active, ((1, 0), (0, 0)))[:-1, :] | jnp.pad(active, ((0, 1), (0, 0)))[1:, :]
-        a = a | jnp.pad(a, ((0, 0), (1, 0)))[:, :-1] | jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
+    for _ in range(dy):
+        if wrap:
+            a = a | jnp.roll(a, 1, 0) | jnp.roll(a, -1, 0)
+        else:
+            a = (a | jnp.pad(a, ((1, 0), (0, 0)))[:-1, :]
+                 | jnp.pad(a, ((0, 1), (0, 0)))[1:, :])
+    for _ in range(dx):
+        if wrap:
+            a = a | jnp.roll(a, 1, 1) | jnp.roll(a, -1, 1)
+        else:
+            a = (a | jnp.pad(a, ((0, 0), (1, 0)))[:, :-1]
+                 | jnp.pad(a, ((0, 0), (0, 1)))[:, 1:])
     return a
 
 
-def _refresh_ring(padded: jax.Array) -> jax.Array:
-    """Torus: the one-word/one-row ring holds wrapped copies of the opposite
-    interior edges (incl. corners), refreshed every generation so edge tiles
-    see current cross-seam neighbors. O(H + Wp) words per generation."""
-    inter = padded[..., 1:-1, 1:-1]
-    padded = padded.at[..., 0, 1:-1].set(inter[..., -1, :])
-    padded = padded.at[..., -1, 1:-1].set(inter[..., 0, :])
-    padded = padded.at[..., 1:-1, 0].set(inter[..., :, -1])
-    padded = padded.at[..., 1:-1, -1].set(inter[..., :, 0])
-    corners = jnp.stack([inter[..., -1, -1], inter[..., -1, 0],
-                         inter[..., 0, -1], inter[..., 0, 0]], axis=-1)
-    return padded.at[..., (0, 0, -1, -1), (0, -1, 0, -1)].set(corners)
+def _refresh_ring(padded: jax.Array, r: int = 1, rw: int = 1) -> jax.Array:
+    """Torus: the (r rows, rw words) ring holds wrapped copies of the
+    opposite interior edges (incl. corners), refreshed every generation so
+    edge tiles see current cross-seam neighbors. O(r·(H + Wp)) words per
+    generation."""
+    inter = padded[..., r:-r, rw:-rw]
+    padded = padded.at[..., :r, rw:-rw].set(inter[..., -r:, :])
+    padded = padded.at[..., -r:, rw:-rw].set(inter[..., :r, :])
+    padded = padded.at[..., r:-r, :rw].set(inter[..., :, -rw:])
+    padded = padded.at[..., r:-r, -rw:].set(inter[..., :, :rw])
+    padded = padded.at[..., :r, :rw].set(inter[..., -r:, -rw:])
+    padded = padded.at[..., :r, -rw:].set(inter[..., -r:, :rw])
+    padded = padded.at[..., -r:, :rw].set(inter[..., :r, -rw:])
+    padded = padded.at[..., -r:, -rw:].set(inter[..., :r, :rw])
+    return padded
 
 
 @lru_cache(maxsize=32)
@@ -178,25 +235,27 @@ def _build_sparse_step(
         raise ValueError(f"at most one leading plane axis, got shape {shape}")
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
+    r, rw = _rule_halo(rule)
 
     def gather_window(padded, ty, tx):
-        # window = tile + 1 halo ring; padded grid offset makes this exact
-        # (leading plane axes, if any, are taken whole)
+        # window = tile + the rule's (r, rw) halo ring; the padded grid's
+        # matching ring offset makes this exact (leading plane axes, if
+        # any, are taken whole)
         return jax.lax.dynamic_slice(
             padded,
             (0,) * len(lead) + (ty * tile_rows, tx * tile_words),
-            lead + (tile_rows + 2, tile_words + 2),
+            lead + (tile_rows + 2 * r, tile_words + 2 * rw),
         )
 
     def sparse_gen(padded, candidates, n_cand):
         if wrap:
-            padded = _refresh_ring(padded)
+            padded = _refresh_ring(padded, r, rw)
         idx = jnp.nonzero(candidates.ravel(), size=capacity, fill_value=0)[0]
         valid = jnp.arange(capacity) < n_cand
         tys, txs = idx // ntx, idx % ntx
         windows = jax.vmap(lambda ty, tx: gather_window(padded, ty, tx))(tys, txs)
         stepped = jax.vmap(lambda w: _step_window(w, rule))(windows)
-        olds = windows[..., 1:-1, 1:-1]
+        olds = windows[..., r:-r, rw:-rw]
         changed_any = jnp.logical_and(
             (stepped != olds).any(axis=tuple(range(1, stepped.ndim))), valid)
 
@@ -205,8 +264,8 @@ def _build_sparse_step(
         # and must not touch state: they are routed out of bounds and
         # dropped; the remaining indices are distinct tiles, so
         # unique_indices is safe.
-        row0 = jnp.where(valid, tys * tile_rows + 1, H + 2)
-        col0 = jnp.where(valid, txs * tile_words + 1, Wp + 2)
+        row0 = jnp.where(valid, tys * tile_rows + r, H + 2 * r)
+        col0 = jnp.where(valid, txs * tile_words + rw, Wp + 2 * rw)
         rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
         cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
         if lead:
@@ -229,8 +288,10 @@ def _build_sparse_step(
         generation whose candidate set exceeds capacity. Returns
         (padded, active, generations_actually_done)."""
 
+        dy, dx = _wake_dilation(rule, tile_rows, tile_words)
+
         def carry_of(padded, active, i):
-            cand = _dilate(active, wrap)
+            cand = _dilate(active, wrap, dy=dy, dx=dx)
             return padded, active, cand, jnp.sum(cand), i
 
         def cond_fn(c):
@@ -263,12 +324,13 @@ def _build_dense_once(
     lead, (H, Wp) = shape[:-2], shape[-2:]
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
+    r, rw = _rule_halo(rule)
 
     @partial(jax.jit, donate_argnums=(0,))
     def dense_once(padded):
         if wrap:
-            padded = _refresh_ring(padded)
-        old = padded[..., 1:-1, 1:-1]
+            padded = _refresh_ring(padded, r, rw)
+        old = padded[..., r:-r, rw:-rw]
         # step the interior against the ring (zero = DEAD boundary;
         # wrapped copies = torus)
         new = _step_window(padded, rule)
@@ -277,7 +339,7 @@ def _build_dense_once(
         changed = (tiles_old != tiles_new).any(
             axis=tuple(range(len(lead))) + (-3, -1))
         padded = jax.lax.dynamic_update_slice(
-            padded, new, (0,) * len(lead) + (1, 1))
+            padded, new, (0,) * len(lead) + (r, rw))
         return padded, changed
 
     return dense_once
@@ -309,28 +371,44 @@ class SparseEngineState:
         # mostly-sleeping universe never pays a 256-tile window batch per
         # generation for 6 active tiles.
         self._adaptive = capacity is None
-        if 0 in rule.born:
+        if _births_from_nothing(rule):
             raise ValueError(
-                f"sparse backend cannot run B0 rules ({rule.notation}): every "
-                "quiescent region births cells each generation, so nothing "
-                "ever sleeps — use the packed backend"
+                f"sparse backend cannot run birth-from-nothing rules "
+                f"({rule.notation}): every quiescent region births cells "
+                "each generation, so nothing ever sleeps — use the packed "
+                "backend"
             )
+        from ..models.ltl import LtLRule
+
+        if isinstance(rule, LtLRule) and rule.neighborhood != "M":
+            raise ValueError(
+                f"sparse LtL serves Moore (box) neighborhoods only — the "
+                f"windowed step is the bit-sliced packed path; diamond "
+                f"rules ({rule.notation}) run on the dense backend")
         self.rule = rule
         self.tile_rows = tile_rows
         self.tile_words = tile_words
         self.topology = topology
         self.shape = tuple(packed.shape)
-        self.padded = _pad_ring(packed)
-        self.active = initial_activity(self.padded, tile_rows, tile_words)
+        self._halo = _rule_halo(rule)       # (rows, words) ring depth
+        r, rw = self._halo
+        self.padded = _pad_ring(packed, r, rw)
+        self.active = initial_activity(self.padded, tile_rows, tile_words,
+                                       r, rw)
         nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
         self._cap_ceiling = min(_MAX_ADAPTIVE_CAPACITY,
                                 1 << (nty * ntx - 1).bit_length())
         if self._adaptive:
-            # 9x the seeded tiles covers the first dilations; pow2 keeps the
-            # lru-cached compile set small across escalations; never batch
-            # more windows than tiles exist (dense seeds would otherwise
-            # pay full compute on fill slots forever)
-            want = max(32, 9 * int(jnp.sum(self.active)))
+            # one dilation factor's worth of headroom over the seeded tiles
+            # covers the first generations ((2dy+1)(2dx+1) = 9 for 3x3
+            # rules, more when a radius-r rule crosses several tile rings);
+            # pow2 keeps the lru-cached compile set small across
+            # escalations; never batch more windows than tiles exist
+            # (dense seeds would otherwise pay full compute on fill slots
+            # forever)
+            dy, dx = _wake_dilation(rule, tile_rows, tile_words)
+            factor = (2 * dy + 1) * (2 * dx + 1)
+            want = max(32, factor * int(jnp.sum(self.active)))
             capacity = min(1 << (want - 1).bit_length(), self._cap_ceiling)
         self._set_capacity(capacity)
 
@@ -367,8 +445,11 @@ class SparseEngineState:
                     # one cheap map reduction tells us the needed capacity:
                     # jump straight there (one recompile) instead of
                     # doubling through several zero-progress dispatches
+                    dy, dx = _wake_dilation(self.rule, self.tile_rows,
+                                            self.tile_words)
                     need = int(jnp.sum(_dilate(
-                        self.active, self.topology is Topology.TORUS)))
+                        self.active, self.topology is Topology.TORUS,
+                        dy=dy, dx=dx)))
                     want = max(2 * self.capacity, need)
                     self._set_capacity(
                         min(1 << (want - 1).bit_length(), self._cap_ceiling))
@@ -389,7 +470,8 @@ class SparseEngineState:
 
     @property
     def packed(self) -> jax.Array:
-        return self.padded[..., 1:-1, 1:-1]
+        r, rw = self._halo
+        return self.padded[..., r:-r, rw:-rw]
 
     def active_tiles(self) -> int:
         return int(jnp.sum(self.active))

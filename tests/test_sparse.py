@@ -577,8 +577,8 @@ class TestSparseGenerations:
         assert sp.population() == ref.population()
         with pytest.raises(ValueError, match="divisible by 32"):
             Engine(np.zeros((16, 48), np.uint8), "brain", backend="sparse")
-        with pytest.raises(ValueError, match="neither a pallas kernel nor"):
-            Engine(np.zeros((16, 32), np.uint8), "bosco", backend="sparse")
+        with pytest.raises(ValueError, match="no pallas kernel"):
+            Engine(np.zeros((16, 32), np.uint8), "bosco", backend="pallas")
 
     def test_sharded_gen_sparse_bit_identity(self):
         """Per-device activity skipping on the plane stack: sharded sparse
@@ -597,3 +597,95 @@ class TestSparseGenerations:
         got.step(24)
         np.testing.assert_array_equal(ref.snapshot(), got.snapshot())
         assert got.population() == ref.population()
+
+
+# -- LtL sparse: radius-r activity tiling -------------------------------------
+
+class TestLtLSparse:
+    """Radius-r rules through the activity-tiled engine: the wake rule
+    dilates by ceil(r/tile) tile rings and windows carry the rule's
+    (r rows, 1 word) halo — the bit-sliced packed step per window."""
+
+    @pytest.mark.parametrize("topology", [Topology.DEAD, Topology.TORUS])
+    def test_bosco_blob_bit_identity(self, topology):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+        from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+
+        rule = parse_any("bosco")                  # r=5 Moore
+        rng = np.random.default_rng(7)
+        grid = np.zeros((128, 256), np.uint8)
+        grid[40:70, 60:100] = rng.integers(0, 2, size=(30, 40))
+        p = jnp.asarray(bitpack.pack_np(grid))
+        want = multi_step_ltl_packed(p, 24, rule=rule, topology=topology)
+        st = SparseEngineState(p, rule, topology=topology)
+        st.step(24)
+        np.testing.assert_array_equal(np.asarray(st.packed), np.asarray(want))
+        assert 0 < st.active_tiles() < st.active.size
+
+    def test_wake_radius_crosses_small_tiles(self):
+        # r=5 with 4-row tiles: influence crosses MORE than one tile
+        # boundary per generation — the dy=ceil(5/4)=2 dilation case
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+        from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+
+        rule = parse_any("bosco")
+        rng = np.random.default_rng(9)
+        grid = np.zeros((64, 128), np.uint8)
+        grid[28:36, 40:80] = rng.integers(0, 2, size=(8, 40))
+        p = jnp.asarray(bitpack.pack_np(grid))
+        want = multi_step_ltl_packed(p, 12, rule=rule, topology=Topology.DEAD)
+        st = SparseEngineState(p, rule, tile_rows=4, tile_words=1,
+                               topology=Topology.DEAD)
+        st.step(12)
+        np.testing.assert_array_equal(np.asarray(st.packed), np.asarray(want))
+
+    def test_torus_seam_crossing_blob(self):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+        from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+
+        rule = parse_any("bosco")
+        rng = np.random.default_rng(13)
+        grid = np.zeros((96, 192), np.uint8)
+        grid[:20, 170:] = rng.integers(0, 2, size=(20, 22))  # corner seam
+        p = jnp.asarray(bitpack.pack_np(grid))
+        want = multi_step_ltl_packed(p, 16, rule=rule, topology=Topology.TORUS)
+        st = SparseEngineState(p, rule, topology=Topology.TORUS)
+        st.step(16)
+        np.testing.assert_array_equal(np.asarray(st.packed), np.asarray(want))
+
+    def test_rejections_and_engine_facade(self):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.models.ltl import LtLRule
+        from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+
+        diamond = parse_any("R2,C0,M0,S6..11,B6..9,NN")
+        with pytest.raises(ValueError, match="Moore"):
+            SparseEngineState(jnp.zeros((32, 1), jnp.uint32), diamond)
+        b0_ltl = LtLRule(radius=2, born=(0, 3), survive=(4, 9))
+        with pytest.raises(ValueError, match="birth-from-nothing"):
+            SparseEngineState(jnp.zeros((32, 1), jnp.uint32), b0_ltl)
+        with pytest.raises(ValueError, match="Moore rule and a width"):
+            Engine(np.zeros((32, 32), np.uint8),
+                   "R2,C0,M0,S6..11,B6..9,NN", backend="sparse")
+
+        # engine facade: sparse bosco == dense bosco
+        rng = np.random.default_rng(3)
+        grid = np.zeros((96, 128), np.uint8)
+        grid[30:60, 40:90] = rng.integers(0, 2, size=(30, 50))
+        sp = Engine(grid, "bosco", backend="sparse", topology=Topology.DEAD)
+        ref = Engine(grid, "bosco", backend="dense", topology=Topology.DEAD)
+        sp.step(10)
+        ref.step(10)
+        np.testing.assert_array_equal(sp.snapshot(), ref.snapshot())
