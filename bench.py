@@ -211,6 +211,18 @@ def run_bench(args) -> None:
         # multi-state rules have a bit-plane packed path (~4x the dense
         # rate on CPU) when the width packs (32 cells/word)
         _route_rule(True, "bit-plane packed")
+    elif isinstance(rule, LtLRule) and args.backend == "pallas":
+        # the radius-r temporal-blocked kernel is honored on EXPLICIT
+        # request at shapes its gate accepts (auto stays on the measured
+        # packed path until the ltl_pallas worklist item proves otherwise)
+        from gameoflifewithactors_tpu.ops.pallas_stencil import ltl_supported
+
+        ok = (explicitly_pallas and side % 32 == 0
+              and ltl_supported((side, side // 32), rule,
+                                on_tpu=platform == "tpu"))
+        if not ok:
+            _route_rule(platform == "tpu" and rule.neighborhood == "M",
+                        "bit-sliced packed")
     elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse"):
         # LtL: bit-sliced packed path on TPU (or when explicitly requested),
         # byte path elsewhere (2.4x faster under CPU XLA — engine routing);
@@ -261,6 +273,16 @@ def run_bench(args) -> None:
         state = pack_generations_for(jnp.asarray(grid), rule)
         run = lambda s, n: multi_step_packed_generations(
             s, n, rule=rule, topology=Topology.TORUS, donate=True)
+    elif isinstance(rule, LtLRule) and args.backend == "pallas":
+        from gameoflifewithactors_tpu.ops.pallas_stencil import (
+            multi_step_ltl_pallas,
+        )
+
+        state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
+        interpret = default_interpret()
+        run = lambda s, n: multi_step_ltl_pallas(
+            s, int(n), rule=rule, topology=Topology.TORUS,
+            interpret=interpret, donate=True)
     elif isinstance(rule, LtLRule) and args.backend == "packed":
         from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
 

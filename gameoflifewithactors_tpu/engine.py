@@ -123,14 +123,11 @@ class Engine:
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
                 "3x3 binary rules, mesh + backend='pallas' for Generations)")
-        if self._ltl and backend == "pallas":
+        if self._ltl and backend == "pallas" and mesh is not None:
             raise ValueError(
-                f"backend='pallas' does not serve LtLRule rules "
-                f"({self.rule.notation}): LtL has no pallas kernel "
-                "(backend='packed' is the bit-sliced bitboard; "
-                "backend='dense' the byte layout; backend='sparse' the "
-                "activity-tiled engine for Moore rules)"
-            )
+                "the LtL pallas kernel is single-device; sharded LtL runs "
+                f"on backend='packed' (bit-sliced) — drop the mesh for the "
+                f"kernel ({self.rule.notation})")
         if self._ltl and backend == "sparse" and mesh is not None:
             raise ValueError(
                 "sharded sparse serves life-like and Generations rules; "
@@ -154,9 +151,11 @@ class Engine:
         # checkpoint); sharded tiles exchange r-row + 1-word halos
         _ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
         _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
-        # sparse LtL rides the same bit-sliced packed windows, so it
-        # shares the packed gate (Moore + word-divisible width)
-        self._ltl_packed = (self._ltl and backend in ("packed", "sparse")
+        # sparse LtL rides the same bit-sliced packed windows and the
+        # pallas LtL kernel the same packed layout, so all three share the
+        # packed gate (Moore + word-divisible width)
+        self._ltl_packed = (self._ltl
+                            and backend in ("packed", "sparse", "pallas")
                             and _packs and self.rule.neighborhood == "M")
         if self._ltl and backend == "sparse" and not self._ltl_packed:
             # an explicit sparse request that sparse cannot serve must not
@@ -165,16 +164,18 @@ class Engine:
                 f"sparse LtL needs a Moore rule and a width divisible by "
                 f"32, got {self.rule.notation} on {self.shape}; use "
                 "backend='dense'")
-        if self._ltl and backend == "packed" and not self._ltl_packed:
-            # the bit-sliced path can't serve this rule/shape (diamond
-            # neighborhood, or width not sharding into whole words): fall
-            # back to the byte path; self.backend reports what actually
-            # runs either way, but only an EXPLICIT backend='packed'
-            # request warns — the auto resolver's fallback is by design
-            if explicit_packed:
+        if (self._ltl and backend in ("packed", "pallas")
+                and not self._ltl_packed):
+            # the bit-sliced/kernel paths can't serve this rule/shape
+            # (diamond neighborhood, or width not sharding into whole
+            # words): fall back to the byte path; self.backend reports
+            # what actually runs either way, but only an EXPLICIT packed/
+            # pallas request warns — the auto resolver's fallback is by
+            # design
+            if explicit_packed or backend == "pallas":
                 warnings.warn(
-                    f"packed LtL unavailable for {self.rule.notation} on "
-                    f"{self.shape} over {_ny} mesh column(s) (Moore-box + "
+                    f"packed/pallas LtL unavailable for {self.rule.notation} "
+                    f"on {self.shape} over {_ny} mesh column(s) (Moore-box + "
                     "word-divisible shard widths only); running the dense "
                     "byte path",
                     stacklevel=3,
@@ -366,6 +367,28 @@ class Engine:
                 state, self.rule, topology=topology, **opts)
             self._run = None  # step() routes through the sparse state
             state = None  # the padded copy inside _sparse is the state now
+        elif backend == "pallas" and self._ltl:
+            # radius-r temporal-blocked kernel (native on TPU, interpret
+            # elsewhere); unsupported shapes fall back to the bit-sliced
+            # XLA path with a warning, like binary pallas
+            interpret = pallas_stencil.default_interpret()
+            if not pallas_stencil.ltl_supported(state.shape, self.rule,
+                                                on_tpu=not interpret):
+                warnings.warn(
+                    f"pallas LtL kernel cannot serve {self.rule.notation} "
+                    f"at {self.shape[0]}x{self.shape[1]} on TPU (lane/"
+                    "sublane alignment or VMEM budget); falling back to "
+                    "the XLA bit-sliced path",
+                    stacklevel=3,
+                )
+                from .ops.packed_ltl import multi_step_ltl_packed
+
+                self._run = lambda s, n: multi_step_ltl_packed(
+                    s, n, rule=self.rule, topology=self.topology, donate=True)
+            else:
+                self._run = lambda s, n: pallas_stencil.multi_step_ltl_pallas(
+                    s, int(n), rule=self.rule, topology=self.topology,
+                    interpret=interpret, donate=True)
         elif backend == "pallas" and not self._generations:
             # native Mosaic on TPU; interpret mode elsewhere (CPU tests)
             interpret = pallas_stencil.default_interpret()

@@ -202,6 +202,23 @@ def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Arra
     return _apply_intervals(p, box_counts_packed(p, rule.radius, topology), rule)
 
 
+def step_ltl_packed_slab(slab: jax.Array, rule: LtLRule,
+                         topology: Topology) -> jax.Array:
+    """(L, Wp) full-width slab -> (L - 2r, Wp): one generation with
+    vertical DEAD closure (the outer r rows are halo, consumed and
+    cropped — the radius-r face of packed.step_packed_slab) and GLOBAL
+    horizontal closure ``topology`` (slab rows span the full grid width,
+    so the horizontal wrap is globally correct). The separable box sum
+    makes the per-axis closure split exact: the vertical column sum uses
+    DEAD shifts, the horizontal sliding sum the global topology."""
+    _require_box(rule)
+    r = rule.radius
+    col = bit_sliced_sum(
+        [vshift(slab, d, Topology.DEAD) for d in range(-r, r + 1)])
+    counts = _sliding_sum_bs(col, 2 * r + 1, topology)
+    return _apply_intervals(slab[r:-r], [c[r:-r] for c in counts], rule)
+
+
 def step_ltl_packed_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
     """One generation from a halo-extended packed tile -> (h, wp) interior.
 

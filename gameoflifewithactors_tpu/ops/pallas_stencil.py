@@ -90,7 +90,7 @@ def _zero_band_exterior(slab, block_idx, bh, g, k, He, edge_ref,
     return jnp.where(top | bot, jnp.uint32(0), slab)
 
 
-def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks, stack: bool):
+def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, halo, n_blocks, stack: bool):
     """The shared double-buffered 3-segment input pipeline: start block
     i+1's copies, wait on block i's (started by the previous grid step or
     the i == 0 prologue), return the revolving buffer index holding block
@@ -98,21 +98,24 @@ def _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, g, n_blocks, stack: bool):
     across them, which is what makes the hand-off sound; output copies are
     pallas-managed (blocked out_specs) and already pipelined by Mosaic.
 
-    The 3 segments (top halo, body, bottom halo) are contiguous because
-    g <= bh. Mosaic must prove the dynamic row offsets divisible by the
-    (8, 128) sublane tiling; the jnp.where obscures that, so assert it
-    with multiple_of (sound: H, bh, g are all multiples of 8 natively).
-    In slab mode the wrap formula is only an arbitrary aligned in-range
-    window — its payload is zeroed after the wait. ``stack=True`` copies
-    the Generations (b, rows, Wp) form, whole plane axis per segment.
+    ``halo`` is the vertical halo depth in ROWS (= g for the 3x3 kernels,
+    r*g for radius-r LtL — the slab consumes 2·(halo/g) rows per in-slab
+    generation either way). The 3 segments (top halo, body, bottom halo)
+    are contiguous because halo <= bh. Mosaic must prove the dynamic row
+    offsets divisible by the (8, 128) sublane tiling; the jnp.where
+    obscures that, so assert it with multiple_of (sound: H, bh, halo are
+    all multiples of 8 natively). In slab mode the wrap formula is only an
+    arbitrary aligned in-range window — its payload is zeroed after the
+    wait. ``stack=True`` copies the Generations (b, rows, Wp) form, whole
+    plane axis per segment.
     """
     def copies(j, buf):
         base = j * bh
-        top = pl.multiple_of(jnp.where(j == 0, H - g, base - g), 8)
+        top = pl.multiple_of(jnp.where(j == 0, H - halo, base - halo), 8)
         bot = pl.multiple_of(jnp.where(j == n_blocks - 1, 0, base + bh), 8)
         out = []
         for k, (src, n, dst) in enumerate(
-                ((top, g, 0), (base, bh, g), (bot, g, g + bh))):
+                ((top, halo, 0), (base, bh, halo), (bot, halo, halo + bh))):
             if stack:
                 out.append(pltpu.make_async_copy(
                     p_hbm.at[:, pl.ds(src, n)],
@@ -276,6 +279,159 @@ def _validate_slab(He: int, bh: int, g: int, interpret: bool,
             + (f", {planes} planes" if planes > 1 else "")
             + f") exceeds the {_VMEM_BUDGET >> 20} MiB budget; "
               "use smaller block_rows or a narrower grid")
+
+
+def _make_ltl_kernel(rule, topology: Topology, H: int, Wp: int, bh: int,
+                     g: int):
+    """Temporal-blocked kernel for radius-r LtL Moore rules (full-grid
+    mode): halo depth r*g rows — the slab shrinks 2r rows per in-slab
+    generation through packed_ltl.step_ltl_packed_slab (vertical DEAD
+    closure on the slab, global horizontal closure in-VMEM). TORUS rides
+    the wrapped DMAs; DEAD re-zeroes the shrinking exterior of boundary
+    blocks before every generation, exactly like the 3x3 form but r rows
+    at a time."""
+    from .packed_ltl import step_ltl_packed_slab
+
+    r = rule.radius
+    hr = r * g
+    n_blocks = H // bh
+    L = bh + 2 * hr
+
+    def kernel(p_hbm, out_ref, slab_ref, sems):
+        i = pl.program_id(0)
+        buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, hr, n_blocks,
+                            stack=False)
+        slab = slab_ref[buf]
+        for k in range(g):
+            if topology is Topology.DEAD:
+                slab = _zero_edge_rows(slab, i, n_blocks, hr - r * k)
+            slab = step_ltl_packed_slab(slab, rule, topology)
+        out_ref[:] = slab
+
+    return kernel, n_blocks, L
+
+
+@lru_cache(maxsize=32)
+def _build_ltl_runner(rule, topology: Topology, shape, bh: int, g: int,
+                      interpret: bool, donate: bool):
+    H, Wp = shape
+    kernel, n_blocks, L = _make_ltl_kernel(rule, topology, H, Wp, bh, g)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((H, Wp), jnp.uint32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, L, Wp), jnp.uint32),      # revolving slab buffers
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(
+        lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+# the bit-sliced box sum holds ~7 count planes of the slab alongside the
+# revolving buffers; budget them (vs the 3x3 kernel's lone carry network)
+_LTL_VMEM_PLANES = 7
+
+
+def _ltl_vmem_bytes(bh: int, hr: int, Wp: int) -> int:
+    L = bh + 2 * hr
+    return ((2 + _LTL_VMEM_PLANES) * L + 2 * bh) * Wp * 4
+
+
+def ltl_supported(shape, rule, *, on_tpu: bool,
+                  gens_per_call: Optional[int] = None) -> bool:
+    """Whether the LtL kernel can run this packed (H, Wp) shape: Moore
+    rule; natively also lane/sublane alignment; and (both modes) a block
+    decomposition with blocks >= the r·g halo within the VMEM budget —
+    a grid shorter than the halo has no decomposition even in interpret
+    mode, and the engine's fallback must know that up front."""
+    if rule.neighborhood != "M":
+        return False
+    H, Wp = shape
+    g = gens_per_call or DEFAULT_GENS_PER_CALL
+    hr = rule.radius * g
+    if on_tpu and (Wp % 128 or H % 8 or hr % 8):
+        return False
+    try:
+        _pick_bh(H, native=on_tpu, at_least=hr, g=hr, Wp=Wp,
+                 vmem_bytes=_ltl_vmem_bytes)
+    except ValueError:
+        return False
+    return True
+
+
+def make_ltl_pallas_step(
+    rule,
+    topology: Topology,
+    shape,
+    *,
+    block_rows: Optional[int] = None,
+    gens_per_call: Optional[int] = None,
+    interpret: bool = False,
+    donate: bool = False,
+):
+    """The cached (loop, g) pair advancing g LtL generations per kernel
+    call — the radius-r twin of :func:`make_pallas_step`. Temporal
+    blocking pays 2·r·g redundant halo rows per block per call, so the
+    HBM-traffic win per generation is the same ~g× as the 3x3 kernel
+    while the compute per cell is the (2r+1)² box network."""
+    from .packed_ltl import _require_box
+
+    _require_box(rule)
+    H, Wp = shape
+    g = gens_per_call or DEFAULT_GENS_PER_CALL
+    hr = rule.radius * g
+    bh = block_rows or _pick_bh(H, native=not interpret, at_least=hr,
+                                g=hr, Wp=Wp, vmem_bytes=_ltl_vmem_bytes)
+    if g < 1 or hr > bh:
+        raise ValueError(
+            f"LtL kernel needs radius*gens ({hr}) <= block_rows ({bh})")
+    if H % bh:
+        raise ValueError(f"grid height {H} not divisible by block rows {bh}")
+    if not interpret and (bh % 8 or hr % 8):
+        raise ValueError(
+            f"native LtL kernel needs block_rows ({bh}) and radius*gens "
+            f"({hr}) to be multiples of 8 (sublane tiling)")
+    if not interpret and Wp % 128:
+        raise ValueError(
+            f"native TPU kernel needs the packed width ({Wp} words) to be "
+            "a multiple of 128 words (lane tiling)")
+    return _build_ltl_runner(rule, topology, (H, Wp), bh, g, interpret,
+                             donate), g
+
+
+def multi_step_ltl_pallas(
+    p: jax.Array,
+    n: int,
+    *,
+    rule,
+    topology: Topology = Topology.TORUS,
+    block_rows: Optional[int] = None,
+    gens_per_call: Optional[int] = None,
+    interpret: bool = False,
+    donate: bool = False,
+) -> jax.Array:
+    """``n`` LtL generations via the temporal-blocked kernel, with the
+    n % g remainder on the XLA bit-sliced path. ``n`` is a Python int."""
+    from .packed_ltl import multi_step_ltl_packed
+
+    loop, g = make_ltl_pallas_step(
+        rule, topology, p.shape, block_rows=block_rows,
+        gens_per_call=gens_per_call, interpret=interpret, donate=donate)
+    chunks, rem = divmod(int(n), g)
+    if chunks:
+        p = loop(p, chunks)
+    if rem:
+        p = multi_step_ltl_packed(p, rem, rule=rule, topology=topology,
+                                  donate=donate or chunks > 0)
+    return p
 
 
 def _gen_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
@@ -485,13 +641,17 @@ def _vmem_bytes(bh: int, g: int, Wp: int) -> int:
 
 
 def _pick_bh(H: int, native: bool = False, at_least: int = 1,
-             g: int = DEFAULT_GENS_PER_CALL, Wp: int = 0) -> int:
+             g: int = DEFAULT_GENS_PER_CALL, Wp: int = 0,
+             vmem_bytes=None) -> int:
     """Largest block height <= max(DEFAULT_BLOCK_ROWS, at_least) dividing H
     (8-aligned when targeting real Mosaic, see the multiple_of hints in the
     kernel), >= ``at_least`` (the slab path's DMA scheme needs blocks at
     least as tall as the exchange depth), and — when ``Wp`` is given —
-    fitting the double-buffered VMEM budget (wide grids get shorter
-    blocks instead of a Mosaic allocation failure)."""
+    fitting the VMEM budget under ``vmem_bytes(bh, g, Wp)`` (the
+    double-buffered model by default, the bit-sliced LtL model via
+    _ltl_vmem_bytes; wide grids get shorter blocks instead of a Mosaic
+    allocation failure)."""
+    vmem_bytes = vmem_bytes or _vmem_bytes
     bh = min(max(DEFAULT_BLOCK_ROWS, at_least), H)
     step = 1
     if native:
@@ -499,7 +659,7 @@ def _pick_bh(H: int, native: bool = False, at_least: int = 1,
         step = 8
     floor = max(at_least, 1)
     while bh >= floor and (
-            H % bh or (Wp and _vmem_bytes(bh, g, Wp) > _VMEM_BUDGET)):
+            H % bh or (Wp and vmem_bytes(bh, g, Wp) > _VMEM_BUDGET)):
         bh -= step
     if bh < floor:
         raise ValueError(

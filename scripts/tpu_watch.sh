@@ -12,7 +12,7 @@
 cd /root/repo
 WATCH_T0=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 export WATCH_T0
-ITEMS=pallas_identity,pallas_autotune,pallas_band,pallas_generations,bench_packed,ltl_bosco,ltl_lowering,generations_brain,profile_trace,sparse_tiled,elementary,config5_sparse
+ITEMS=pallas_identity,pallas_autotune,pallas_band,pallas_generations,bench_packed,ltl_bosco,ltl_lowering,ltl_pallas,generations_brain,profile_trace,sparse_tiled,elementary,config5_sparse
 export ITEMS
 trap 'rm -f "${PROBE_OUT:-}"' EXIT
 

@@ -19,6 +19,7 @@ Items:
   ltl_bosco         LtL: on-chip identity vs CPU + dense and bit-sliced rates
   generations_brain Generations path: on-chip bit-identity vs CPU + rate
   ltl_lowering      compiled-HLO evidence the LtL step lowers conv-free (VPU tree)
+  ltl_pallas        radius-r LtL kernel: native identity + bosco 16384² rate
   sparse_tiled      per-tile sharded sparse: native identity + 16384² gun rate
   elementary        1D Wolfram family: numpy-oracle identity + ensemble rate
   config5_sparse    65536² Gosper gun sparse on the chip
@@ -448,6 +449,73 @@ def child_profile_trace() -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def child_ltl_pallas() -> dict:
+    """The radius-r LtL temporal-blocked kernel natively: on-chip
+    bit-identity vs the XLA bit-sliced path, then the bench-shape rate
+    for bosco (r=5) vs that path under the long-run protocol."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+    from gameoflifewithactors_tpu.ops.pallas_stencil import (
+        default_interpret,
+        ltl_supported,
+        multi_step_ltl_pallas,
+    )
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    rule = parse_any("bosco")
+    rng = np.random.default_rng(17)
+    # native Mosaic on the chip; the WORKLIST_SMOKE CPU validation runs
+    # the same logic in interpret mode (smaller shapes below)
+    interpret = default_interpret() if _SMOKE else False
+    out = {"platform": jax.devices()[0].platform, "cases": []}
+    for (h, w) in (((256, 1024),) if _SMOKE else ((512, 4096), (1024, 8192))):
+        p = jnp.asarray(rng.integers(0, 2 ** 32, size=(h, w // 32),
+                                     dtype=np.uint32))
+        assert ltl_supported(p.shape, rule, on_tpu=not interpret)
+        for topology in (Topology.TORUS, Topology.DEAD):
+            for gens in (8, 19):
+                want = multi_step_ltl_packed(p, gens, rule=rule,
+                                             topology=topology)
+                got = multi_step_ltl_pallas(p, gens, rule=rule,
+                                            topology=topology,
+                                            interpret=interpret)
+                same = _device_equal(got, want)
+                out["cases"].append({"shape": [h, w],
+                                     "topology": topology.value,
+                                     "gens": gens, "bit_identical": same})
+                if not same:
+                    out["ok"] = False
+                    return out
+
+    # rate at the bench shape, both paths, long-run protocol
+    side, gens = (2048, 32) if _SMOKE else (16384, 256)
+    big = rng.integers(0, 2 ** 32, size=(side, side // 32), dtype=np.uint32)
+    rates = {}
+    for name, runner in (
+            ("pallas", lambda s, n: multi_step_ltl_pallas(
+                s, int(n), rule=rule, topology=Topology.TORUS,
+                interpret=interpret, donate=True)),
+            ("packed", lambda s, n: multi_step_ltl_packed(
+                s, n, rule=rule, topology=Topology.TORUS, donate=True))):
+        # fresh buffer per runner: donate=True consumes it
+        s = runner(jnp.asarray(big), 8)
+        _sync_scalar(s)
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s = runner(s, gens)
+            _sync_scalar(s)
+            best = max(best, side * side * gens / (time.perf_counter() - t0))
+        rates[name] = best
+    out["ok"] = True
+    out["cell_updates_per_sec"] = rates
+    return out
+
+
 def child_sparse_tiled() -> dict:
     """Per-tile sharded sparse (parallel/sharded.py
     make_multi_step_packed_sparse_tiled, round-3 feature) on a (1, 1) mesh
@@ -586,6 +654,7 @@ ITEMS = {
     "pallas_band": child_pallas_band,
     "pallas_generations": child_pallas_generations,
     "profile_trace": child_profile_trace,
+    "ltl_pallas": child_ltl_pallas,
     "sparse_tiled": child_sparse_tiled,
     "elementary": child_elementary,
     "config5_sparse": child_config5_sparse,
