@@ -118,16 +118,13 @@ class Engine:
                 mesh is not None
                 and ((backend in ("packed", "pallas")
                       and not (self._generations or self._ltl))
-                     or (backend == "pallas" and self._generations))):
+                     or (backend == "pallas"
+                         and (self._generations or self._ltl)))):
             raise ValueError(
                 "gens_per_exchange applies to the sharded packed and pallas "
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
-                "3x3 binary rules, mesh + backend='pallas' for Generations)")
-        if self._ltl and backend == "pallas" and mesh is not None:
-            raise ValueError(
-                "the LtL pallas kernel is single-device; sharded LtL runs "
-                f"on backend='packed' (bit-sliced) — drop the mesh for the "
-                f"kernel ({self.rule.notation})")
+                "3x3 binary rules, mesh + backend='pallas' for Generations "
+                "and LtL)")
         if self._ltl and backend == "sparse" and mesh is not None:
             raise ValueError(
                 "sharded sparse serves life-like and Generations rules; "
@@ -172,6 +169,15 @@ class Engine:
             # what actually runs either way, but only an EXPLICIT packed/
             # pallas request warns — the auto resolver's fallback is by
             # design
+            if gens_per_exchange != 1:
+                # the dense fallback has no communication-avoiding runner:
+                # dropping the requested exchange depth silently would be
+                # a contract violation (same rule as the Generations twin)
+                raise ValueError(
+                    f"gens_per_exchange={gens_per_exchange} needs the LtL "
+                    f"band kernel, but {self.rule.notation} on {self.shape} "
+                    "cannot take the packed path (Moore-box + "
+                    "word-divisible widths only)")
             if explicit_packed or backend == "pallas":
                 warnings.warn(
                     f"packed/pallas LtL unavailable for {self.rule.notation} "
@@ -242,6 +248,20 @@ class Engine:
             state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
             state = mesh_lib.device_put_sharded_grid(state, mesh)
+            def _band_kernel(make_band, make_pergen):
+                # row-band native kernel: bulk chunks of g generations
+                # through the slab kernel, n % g remainders on the
+                # per-generation runner — one definition for the binary,
+                # Generations, and LtL twins
+                g = (gens_per_exchange if gens_per_exchange > 1
+                     else pallas_stencil.DEFAULT_GENS_PER_CALL)
+                self.gens_per_exchange = g
+                return _chunked(
+                    make_band(mesh, self.rule, topology,
+                              gens_per_exchange=g, donate=True),
+                    make_pergen(mesh, self.rule, topology, donate=True),
+                    g)
+
             def _tiled_sparse(make):
                 # shared tile-dim resolution for the per-tile sharded
                 # sparse runners (binary bitboard / Generations stack):
@@ -275,7 +295,11 @@ class Engine:
                         f"smaller than the rule radius {r}: halo exchange "
                         "needs depth <= tile size; use fewer devices"
                     )
-                if self._ltl_packed:
+                if self._ltl_packed and backend == "pallas":
+                    self._run = _band_kernel(
+                        sharded.make_multi_step_ltl_pallas,
+                        sharded.make_multi_step_ltl_packed)
+                elif self._ltl_packed:
                     self._run = sharded.make_multi_step_ltl_packed(
                         mesh, self.rule, topology, donate=True)
                 else:
@@ -287,18 +311,9 @@ class Engine:
                     self._run = _tiled_sparse(
                         sharded.make_multi_step_generations_packed_sparse_tiled)
                 elif self._gen_packed and backend == "pallas":
-                    # row-band native kernel over the plane stack; n % g
-                    # remainders take the per-gen sharded plane runner
-                    g = (gens_per_exchange if gens_per_exchange > 1
-                         else pallas_stencil.DEFAULT_GENS_PER_CALL)
-                    self.gens_per_exchange = g
-                    self._run = _chunked(
-                        sharded.make_multi_step_generations_pallas(
-                            mesh, self.rule, topology, gens_per_exchange=g,
-                            donate=True),
-                        sharded.make_multi_step_generations_packed(
-                            mesh, self.rule, topology, donate=True),
-                        g)
+                    self._run = _band_kernel(
+                        sharded.make_multi_step_generations_pallas,
+                        sharded.make_multi_step_generations_packed)
                 elif self._gen_packed:
                     self._run = sharded.make_multi_step_generations_packed(
                         mesh, self.rule, topology, donate=True)
@@ -317,18 +332,10 @@ class Engine:
                 # row-band native kernel: exchange a depth-g halo, advance g
                 # gens in the Mosaic slab kernel, crop (parallel/sharded.py
                 # make_multi_step_pallas — (nx, 1) meshes, both topologies;
-                # it raises with directions otherwise). n % g remainders
-                # take the per-gen SWAR runner.
-                g = (gens_per_exchange if gens_per_exchange > 1
-                     else pallas_stencil.DEFAULT_GENS_PER_CALL)
-                self.gens_per_exchange = g
-                self._run = _chunked(
-                    sharded.make_multi_step_pallas(
-                        mesh, self.rule, topology, gens_per_exchange=g,
-                        donate=True),
-                    sharded.make_multi_step_packed(
-                        mesh, self.rule, topology, donate=True),
-                    g)
+                # it raises with directions otherwise)
+                self._run = _band_kernel(
+                    sharded.make_multi_step_pallas,
+                    sharded.make_multi_step_packed)
             else:
                 make = (
                     sharded.make_multi_step_packed

@@ -66,27 +66,28 @@ def _zero_edge_rows(slab, block_idx, n_blocks, halo, row_axis: int = 0):
     return jnp.where(top_ext | bot_ext, jnp.uint32(0), slab)
 
 
-def _zero_band_exterior(slab, block_idx, bh, g, k, He, edge_ref,
+def _zero_band_exterior(slab, block_idx, bh, halo, shrunk, He, edge_ref,
                         row_axis: int = 0):
     """Per-generation re-zero of the permanently-dead exterior of a
     global-edge row band (slab mode, DEAD vertical closure). The extended
-    band's outer g rows are exterior on a global-edge device — cells born
-    there by the free slab evolution would feed back into the interior from
-    the 2nd in-slab generation on (the same failure mode full-grid DEAD
-    guards against). Masks by GLOBAL extended-row index: the slab shrinks
-    2 rows per in-slab generation, so after ``k`` generations slab row
-    ``s`` is extended row ``block*bh + s - (g - k)``; global indexing also
-    keeps any block decomposition correct (with bh < 2g the exterior spans
-    two blocks). Gated at runtime by the device's edge code (bit0 = global
+    band's outer ``halo`` rows are exterior on a global-edge device —
+    cells born there by the free slab evolution would feed back into the
+    interior from the 2nd in-slab generation on (the same failure mode
+    full-grid DEAD guards against). Masks by GLOBAL extended-row index:
+    after ``shrunk`` rows have been consumed per side (k generations ×
+    the rule's radius), slab row ``s`` is extended row
+    ``block*bh + s - (halo - shrunk)``; global indexing also keeps any
+    block decomposition correct (with bh < 2·halo the exterior spans two
+    blocks). Gated at runtime by the device's edge code (bit0 = global
     top band, bit1 = bottom), an SMEM scalar — the compiled program is
     shared by every device in the shard_map, so edge-ness must be data,
     not code.
     """
     code = edge_ref[0, 0]
     rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, row_axis)
-    ext_row = block_idx * bh + rows - (g - k)
-    top = ((code & 1) == 1) & (ext_row < g)
-    bot = ((code & 2) == 2) & (ext_row >= He - g)
+    ext_row = block_idx * bh + rows - (halo - shrunk)
+    top = ((code & 1) == 1) & (ext_row < halo)
+    bot = ((code & 2) == 2) & (ext_row >= He - halo)
     return jnp.where(top | bot, jnp.uint32(0), slab)
 
 
@@ -282,14 +283,21 @@ def _validate_slab(He: int, bh: int, g: int, interpret: bool,
 
 
 def _make_ltl_kernel(rule, topology: Topology, H: int, Wp: int, bh: int,
-                     g: int):
-    """Temporal-blocked kernel for radius-r LtL Moore rules (full-grid
-    mode): halo depth r*g rows — the slab shrinks 2r rows per in-slab
-    generation through packed_ltl.step_ltl_packed_slab (vertical DEAD
-    closure on the slab, global horizontal closure in-VMEM). TORUS rides
-    the wrapped DMAs; DEAD re-zeroes the shrinking exterior of boundary
-    blocks before every generation, exactly like the 3x3 form but r rows
-    at a time."""
+                     g: int, slab_mode: bool = False,
+                     dead_band: bool = False):
+    """Temporal-blocked kernel for radius-r LtL Moore rules: halo depth
+    r*g rows — the slab shrinks 2r rows per in-slab generation through
+    packed_ltl.step_ltl_packed_slab (vertical DEAD closure on the slab,
+    global horizontal closure in-VMEM).
+
+    Full-grid mode: TORUS rides the wrapped DMAs; DEAD re-zeroes the
+    shrinking exterior of boundary blocks before every generation, exactly
+    like the 3x3 form but r rows at a time. Slab mode (+``dead_band``):
+    same two closure modes as _make_kernel — the H rows are a
+    halo-extended row band (outer r*g rows = exchanged data), out-of-range
+    DMA payloads are zeroed once, and under a global DEAD vertical closure
+    the SMEM edge code drives the per-generation exterior re-zero (the
+    shrink argument is r·k, not k)."""
     from .packed_ltl import step_ltl_packed_slab
 
     r = rule.radius
@@ -297,30 +305,49 @@ def _make_ltl_kernel(rule, topology: Topology, H: int, Wp: int, bh: int,
     n_blocks = H // bh
     L = bh + 2 * hr
 
-    def kernel(p_hbm, out_ref, slab_ref, sems):
+    def kernel(p_hbm, *refs):
+        if dead_band:
+            edge_ref, out_ref, slab_ref, sems = refs
+        else:
+            out_ref, slab_ref, sems = refs
         i = pl.program_id(0)
         buf = _dma_pipeline(p_hbm, slab_ref, sems, i, H, bh, hr, n_blocks,
                             stack=False)
         slab = slab_ref[buf]
-        for k in range(g):
-            if topology is Topology.DEAD:
-                slab = _zero_edge_rows(slab, i, n_blocks, hr - r * k)
-            slab = step_ltl_packed_slab(slab, rule, topology)
+        if slab_mode:
+            for k in range(g):
+                if k == 0:
+                    slab = _zero_edge_rows(slab, i, n_blocks, hr)
+                if dead_band:
+                    slab = _zero_band_exterior(slab, i, bh, hr, r * k, H,
+                                               edge_ref)
+                slab = step_ltl_packed_slab(slab, rule, topology)
+        else:
+            for k in range(g):
+                if topology is Topology.DEAD:
+                    slab = _zero_edge_rows(slab, i, n_blocks, hr - r * k)
+                slab = step_ltl_packed_slab(slab, rule, topology)
         out_ref[:] = slab
 
     return kernel, n_blocks, L
 
 
-@lru_cache(maxsize=32)
-def _build_ltl_runner(rule, topology: Topology, shape, bh: int, g: int,
-                      interpret: bool, donate: bool):
+def _ltl_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
+                     interpret: bool, slab_mode: bool,
+                     dead_band: bool = False):
     H, Wp = shape
-    kernel, n_blocks, L = _make_ltl_kernel(rule, topology, H, Wp, bh, g)
-    call = pl.pallas_call(
+    kernel, n_blocks, L = _make_ltl_kernel(rule, topology, H, Wp, bh, g,
+                                           slab_mode=slab_mode,
+                                           dead_band=dead_band)
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    if dead_band:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                     memory_space=pltpu.SMEM))
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((H, Wp), jnp.uint32),
         grid=(n_blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bh, Wp), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -329,10 +356,57 @@ def _build_ltl_runner(rule, topology: Topology, shape, bh: int, g: int,
         ],
         interpret=interpret,
     )
+
+
+@lru_cache(maxsize=32)
+def _build_ltl_runner(rule, topology: Topology, shape, bh: int, g: int,
+                      interpret: bool, donate: bool):
+    call = _ltl_pallas_call(rule, topology, shape, bh, g, interpret,
+                            slab_mode=False)
     return jax.jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
         donate_argnums=(0,) if donate else (),
     )
+
+
+@lru_cache(maxsize=32)
+def make_ltl_pallas_slab_step(
+    rule,
+    topology: Topology,
+    ext_shape,
+    *,
+    gens: int,
+    block_rows: Optional[int] = None,
+    interpret: bool = False,
+    dead_band: bool = False,
+):
+    """``ext (He, Wp) -> (He, Wp)`` advancing ``gens`` LtL generations of
+    a halo-extended full-width row band (He = band + 2·r·gens); the
+    caller crops ``out[r*gens:-r*gens]``. The radius-r twin of
+    :func:`make_pallas_slab_step`, same ``dead_band`` SMEM edge-code
+    contract; shard_map callers need ``check_vma=False``."""
+    from .packed_ltl import _require_box
+
+    _require_box(rule)
+    He, Wp = ext_shape
+    g = int(gens)
+    hr = rule.radius * g
+    bh = block_rows or _pick_bh(He, native=not interpret, at_least=hr,
+                                g=hr, Wp=Wp, vmem_bytes=_ltl_vmem_bytes)
+    if hr > bh:
+        raise ValueError(
+            f"LtL slab kernel needs radius*gens ({hr}) <= block_rows ({bh})")
+    _validate_slab(He, bh, hr, interpret, Wp=Wp)
+    if not interpret and _ltl_vmem_bytes(bh, hr, Wp) > _VMEM_BUDGET:
+        # the generic check models the binary kernel; the bit-sliced box
+        # sum's count planes need the larger LtL budget
+        raise ValueError(
+            f"LtL kernel VMEM footprint {_ltl_vmem_bytes(bh, hr, Wp)} bytes "
+            f"(block_rows={bh}, radius*gens={hr}, width {Wp * 32} cells) "
+            f"exceeds the {_VMEM_BUDGET >> 20} MiB budget; use smaller "
+            "block_rows or a shallower exchange")
+    return _ltl_pallas_call(rule, topology, (He, Wp), bh, g, interpret,
+                            slab_mode=True, dead_band=dead_band)
 
 
 # the bit-sliced box sum holds ~7 count planes of the slab alongside the

@@ -211,6 +211,60 @@ def make_multi_step_packed_sparse_tiled(
         tile_rows, tile_words, capacity, donate)
 
 
+def make_multi_step_ltl_pallas(
+    mesh: Mesh,
+    rule,
+    topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+    donate: bool = False,
+) -> Callable:
+    """Row-band sharding over the radius-r LtL kernel: the LtL twin of
+    :func:`make_multi_step_pallas` (same (nx, 1) contract and SMEM
+    edge-code DEAD closure — see that docstring), with the exchange depth
+    and crop scaled to r·g rows (LtL influence travels r rows per
+    generation). Returns jitted ``(grid, chunks) -> grid`` advancing
+    ``chunks * g`` generations, grid sharded P('x', None)."""
+    from ..ops.pallas_stencil import default_interpret, make_ltl_pallas_slab_step
+
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if ny != 1:
+        raise ValueError(
+            f"make_multi_step_ltl_pallas needs an (nx, 1) row-band mesh "
+            f"(got ny={ny}); use make_multi_step_ltl_packed")
+    g = int(gens_per_exchange)
+    hr = rule.radius * g
+    if interpret is None:
+        interpret = default_interpret()
+
+    band_spec = P(ROW_AXIS, None)
+    dead = topology is Topology.DEAD
+
+    def chunk(tile):
+        if hr > tile.shape[0]:  # static shapes: caught at trace time
+            raise ValueError(
+                f"radius*gens_per_exchange={hr} exceeds the per-device band "
+                f"height {tile.shape[0]} (exchange_rows needs depth <= band "
+                "rows)")
+        ext = exchange_rows(tile, nx, topology, depth=hr)
+        call = make_ltl_pallas_slab_step(
+            rule, topology, ext.shape, gens=g, block_rows=block_rows,
+            interpret=interpret, dead_band=dead)
+        if dead:
+            return call(ext, band_edge_code(nx))[hr:-hr]
+        return call(ext)[hr:-hr]
+
+    # check_vma=False: same scratch-DMA typing limitation as the other
+    # band runners
+    @partial(shard_map, mesh=mesh, in_specs=(band_spec, P()),
+             out_specs=band_spec, check_vma=False)
+    def _run(tile, chunks):
+        return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
+
+    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
+
 def make_multi_step_generations_packed_sparse_tiled(
     mesh: Mesh,
     rule,

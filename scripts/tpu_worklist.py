@@ -491,6 +491,27 @@ def child_ltl_pallas() -> dict:
                     out["ok"] = False
                     return out
 
+    # band-runner composition on a (1, 1) mesh: the slab-mode LtL kernel
+    # (+ DEAD edge code) must compile natively and stay exact
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.parallel import sharded
+
+    m = mesh_lib.make_mesh((1, 1), jax.devices()[:1])
+    bh_, bw_ = (256, 1024) if _SMOKE else (512, 4096)
+    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(bh_, bw_ // 32),
+                                 dtype=np.uint32))
+    for topology in (Topology.TORUS, Topology.DEAD):
+        want = multi_step_ltl_packed(p, 16, rule=rule, topology=topology)
+        run = sharded.make_multi_step_ltl_pallas(
+            m, rule, topology, gens_per_exchange=8, interpret=interpret)
+        got = run(mesh_lib.device_put_sharded_grid(p, m), 2)
+        same = _device_equal(got, want)
+        out["cases"].append({"band": True, "topology": topology.value,
+                             "bit_identical": same})
+        if not same:
+            out["ok"] = False
+            return out
+
     # rate at the bench shape, both paths, long-run protocol
     side, gens = (2048, 32) if _SMOKE else (16384, 256)
     big = rng.integers(0, 2 ** 32, size=(side, side // 32), dtype=np.uint32)
