@@ -633,18 +633,24 @@ class Engine:
         g = self.gens_per_exchange
         if self._ltl_packed:
             # r halo rows of packed words + ONE halo word per side
-            # (32 >= r cells), on a (h + 2r)-row-extended tile
-            row_strip = depth * (wq // ny) * itemsize
-            col_strip = (h // nx + 2 * depth) * itemsize
+            # (32 >= r cells), on a (h + 2r)-row-extended tile; the band
+            # kernel (g > 1) ships r·g-deep strips once per chunk — the
+            # per-chunk figure here, amortized /g below, lands back on the
+            # same r rows/generation as the per-gen runner
+            row_strip = depth * g * (wq // ny) * itemsize
+            col_strip = (h // nx + 2 * depth * g) * itemsize
         elif self._gen_packed:
-            # b uint32 bit-planes, each with 1-row / 1-word halos
+            # b uint32 bit-planes, each with 1-row / 1-word halos; the
+            # band kernel (g > 1) ships g-deep plane strips once per chunk
+            # — per-chunk figure here, amortized /g below (same shape as
+            # the LtL branch above)
             from .ops.packed_generations import n_planes
 
             b = n_planes(self.rule.states)
             wq = w // bitpack.WORD
             itemsize = 4
-            row_strip = b * (wq // ny) * itemsize
-            col_strip = b * (h // nx + 2) * itemsize
+            row_strip = b * g * (wq // ny) * itemsize
+            col_strip = b * (h // nx + 2 * g) * itemsize
         elif g > 1:
             # communication-avoiding runner: one exchange of g-deep row
             # strips + 1-word column strips per g generations, amortized

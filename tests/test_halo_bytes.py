@@ -63,6 +63,22 @@ def test_sharded_sparse_includes_flag_traffic():
     assert got == est, f"sparse halo estimate {est} != measured {got}"
 
 
+def test_ltl_band_estimate_matches_per_gen_rate():
+    """The LtL band kernel ships r*g-deep strips once per chunk: amortized
+    per generation that is exactly the per-gen runner's r rows (review
+    finding: the estimate undercounted the band engine g-fold)."""
+    m = _mesh((4, 1))
+    g = np.zeros((96, 128), np.uint8)
+    pergen = Engine(g, "R2,C0,M1,S9..16,B8..12", mesh=m, backend="packed")
+    band = Engine(g, "R2,C0,M1,S9..16,B8..12", mesh=m, backend="pallas",
+                  gens_per_exchange=2)
+    assert band.halo_bytes_per_gen() == pergen.halo_bytes_per_gen() > 0
+    # the Generations band twin amortizes to the per-gen plane rate too
+    gp = Engine(g, "brain", mesh=m, backend="packed")
+    gb = Engine(g, "brain", mesh=m, backend="pallas", gens_per_exchange=2)
+    assert gb.halo_bytes_per_gen() == gp.halo_bytes_per_gen() > 0
+
+
 def test_unsharded_engine_moves_nothing():
     eng = Engine(_grid(64, 64), rule="B3/S23")
     assert eng.halo_bytes_per_gen() == 0
