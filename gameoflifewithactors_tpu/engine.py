@@ -150,25 +150,24 @@ class Engine:
         _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
         # sparse LtL rides the same bit-sliced packed windows and the
         # pallas LtL kernel the same packed layout, so all three share the
-        # packed gate (Moore + word-divisible width)
+        # packed gate (word-divisible width; both neighborhoods — the
+        # diamond sum is per-row separable, ops/packed_ltl.py)
         self._ltl_packed = (self._ltl
                             and backend in ("packed", "sparse", "pallas")
-                            and _packs and self.rule.neighborhood == "M")
+                            and _packs)
         if self._ltl and backend == "sparse" and not self._ltl_packed:
             # an explicit sparse request that sparse cannot serve must not
             # silently become a dense run
             raise ValueError(
-                f"sparse LtL needs a Moore rule and a width divisible by "
-                f"32, got {self.rule.notation} on {self.shape}; use "
-                "backend='dense'")
+                f"sparse LtL needs a width divisible by 32, got "
+                f"{self.rule.notation} on {self.shape}; use backend='dense'")
         if (self._ltl and backend in ("packed", "pallas")
                 and not self._ltl_packed):
-            # the bit-sliced/kernel paths can't serve this rule/shape
-            # (diamond neighborhood, or width not sharding into whole
-            # words): fall back to the byte path; self.backend reports
-            # what actually runs either way, but only an EXPLICIT packed/
-            # pallas request warns — the auto resolver's fallback is by
-            # design
+            # the bit-sliced/kernel paths can't serve this shape (width
+            # not sharding into whole words): fall back to the byte path;
+            # self.backend reports what actually runs either way, but only
+            # an EXPLICIT packed/pallas request warns — the auto
+            # resolver's fallback is by design
             if gens_per_exchange != 1:
                 # the dense fallback has no communication-avoiding runner:
                 # dropping the requested exchange depth silently would be
@@ -176,13 +175,13 @@ class Engine:
                 raise ValueError(
                     f"gens_per_exchange={gens_per_exchange} needs the LtL "
                     f"band kernel, but {self.rule.notation} on {self.shape} "
-                    "cannot take the packed path (Moore-box + "
-                    "word-divisible widths only)")
+                    "cannot take the packed path (word-divisible widths "
+                    "only)")
             if explicit_packed or backend == "pallas":
                 warnings.warn(
                     f"packed/pallas LtL unavailable for {self.rule.notation} "
-                    f"on {self.shape} over {_ny} mesh column(s) (Moore-box + "
-                    "word-divisible shard widths only); running the dense "
+                    f"on {self.shape} over {_ny} mesh column(s) "
+                    "(word-divisible shard widths only); running the dense "
                     "byte path",
                     stacklevel=3,
                 )
@@ -517,16 +516,15 @@ class Engine:
             # the bit-sliced LtL path wins on the TPU VPU but measured
             # ~2.4x slower than the byte path under XLA's CPU lowering;
             # pick per platform (explicit backend='packed' still forces it).
-            # Diamond (von Neumann) rules are dense-only — the bit-sliced
-            # path is built from separable box sums. The width must shard
-            # into whole words across the mesh columns, or the constructor
-            # would immediately walk the choice back to dense.
+            # Both neighborhoods pack (the diamond sum is per-row
+            # separable). The width must shard into whole words across the
+            # mesh columns, or the constructor would immediately walk the
+            # choice back to dense.
             on_tpu = not pallas_stencil.default_interpret()
             shape = np.shape(grid)
             ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
             if (on_tpu and len(shape) == 2
-                    and shape[1] % (bitpack.WORD * ny) == 0
-                    and self.rule.neighborhood == "M"):
+                    and shape[1] % (bitpack.WORD * ny) == 0):
                 return "packed"
             return "dense"
         if self._generations:

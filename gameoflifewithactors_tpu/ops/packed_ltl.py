@@ -1,4 +1,4 @@
-"""Bit-packed Larger-than-Life: bit-sliced box sums, 32 cells per word.
+"""Bit-packed Larger-than-Life: bit-sliced window sums, 32 cells per word.
 
 The dense LtL path (ops/ltl.py) moves one int32 per cell through its
 log-tree window sums; here the grid stays a packed binary bitboard and
@@ -10,6 +10,10 @@ every cell's count — so one bitwise op advances 32 cells:
 - the horizontal window is the same doubling tree ops/ltl.py uses, but
   each "add" is a plane-wise ripple adder over bit-sliced numbers and
   each "shift" is a cell shift with cross-word bit carries;
+- von Neumann (diamond) neighborhoods are not (x, y)-separable but ARE
+  per-row separable: r+1 shrinking sliding sums over pre-added ±d row
+  pairs (diamond_counts_packed) — ~r× the box work, same bit-level
+  vocabulary;
 - the B/S interval tests are bit-sliced subtract-borrow comparators
   against the constant bounds.
 
@@ -169,11 +173,53 @@ def _sliding_sum_bs(num: List[jax.Array], k: int, topology: Topology) -> List[ja
     return bs_add(bs_add(west, east), num)
 
 
-def box_counts_packed(p: jax.Array, radius: int, topology: Topology) -> List[jax.Array]:
-    """Bit-sliced (2r+1)^2 box sums (center included) of a packed plane."""
+def box_counts_packed(p: jax.Array, radius: int, topology: Topology,
+                      h_topo: Topology | None = None) -> List[jax.Array]:
+    """Bit-sliced (2r+1)^2 box sums (center included) of a packed plane.
+    ``h_topo`` splits the horizontal closure off the vertical one (the
+    slab form passes vertical DEAD + global horizontal); default equal."""
     k = 2 * radius + 1
     col = bit_sliced_sum([vshift(p, d, topology) for d in range(-radius, radius + 1)])
-    return _sliding_sum_bs(col, k, topology)
+    return _sliding_sum_bs(col, k, topology if h_topo is None else h_topo)
+
+
+def diamond_counts_packed(p: jax.Array, radius: int, v_topo: Topology,
+                          h_topo: Topology) -> List[jax.Array]:
+    """Bit-sliced von Neumann (diamond) sums: |dx| + |dy| <= radius.
+
+    The diamond is not (x, y)-separable like the box, but it IS per-row
+    separable: the rows at vertical offsets ±d contribute a centered
+    horizontal window of width 2·(radius-d)+1, so the whole sum is r+1
+    shrinking sliding sums (the ±d row pair is pre-added into one 2-plane
+    number so each width is swept once) accumulated with bit-sliced adds —
+    ~r× the box path's work, the price of non-separability, still 32
+    cells per bitwise op. Split topologies serve the slab form (vertical
+    DEAD on the slab, global horizontal closure)."""
+    # counts never exceed the diamond's cell count, so planes past its
+    # bit length are identically zero — truncating after every add keeps
+    # the comparators and the pallas VMEM working set at ~log2(cells)
+    # planes instead of growing a carry plane per accumulation
+    nbits = (2 * radius * radius + 2 * radius + 1).bit_length()
+    acc = None
+    for d in range(radius + 1):
+        if d == 0:
+            planes: List[jax.Array] = [p]
+        else:
+            planes = bit_sliced_sum([vshift(p, -d, v_topo),
+                                     vshift(p, d, v_topo)])
+        term = _sliding_sum_bs(planes, 2 * (radius - d) + 1, h_topo)
+        acc = term if acc is None else bs_add(acc, term)[:nbits]
+    return acc
+
+
+def neighborhood_counts_packed(p: jax.Array, rule: LtLRule, v_topo: Topology,
+                               h_topo: Topology) -> List[jax.Array]:
+    """The rule's neighborhood sum in bit-sliced form, with independent
+    vertical/horizontal closures (equal for full grids; the slab form
+    passes vertical DEAD + global horizontal)."""
+    if rule.neighborhood == "M":
+        return box_counts_packed(p, rule.radius, v_topo, h_topo)
+    return diamond_counts_packed(p, rule.radius, v_topo, h_topo)
 
 
 def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> jax.Array:
@@ -186,20 +232,10 @@ def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> ja
     return born | keep
 
 
-def _require_box(rule: LtLRule) -> None:
-    """The bit-sliced path is built from separable box sums; von Neumann
-    (diamond) rules take the dense prefix-sum path (ops/ltl.py)."""
-    if rule.neighborhood != "M":
-        raise ValueError(
-            f"the packed LtL path supports Moore-box neighborhoods only "
-            f"(got {rule.notation}); use the dense path "
-            f"(backend='dense' / ops.ltl) for von Neumann rules")
-
-
 def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
-    """One generation on a (H, W/32) packed binary grid."""
-    _require_box(rule)
-    return _apply_intervals(p, box_counts_packed(p, rule.radius, topology), rule)
+    """One generation on a (H, W/32) packed binary grid (box or diamond)."""
+    return _apply_intervals(
+        p, neighborhood_counts_packed(p, rule, topology, topology), rule)
 
 
 def step_ltl_packed_slab(slab: jax.Array, rule: LtLRule,
@@ -208,14 +244,11 @@ def step_ltl_packed_slab(slab: jax.Array, rule: LtLRule,
     vertical DEAD closure (the outer r rows are halo, consumed and
     cropped — the radius-r face of packed.step_packed_slab) and GLOBAL
     horizontal closure ``topology`` (slab rows span the full grid width,
-    so the horizontal wrap is globally correct). The separable box sum
-    makes the per-axis closure split exact: the vertical column sum uses
-    DEAD shifts, the horizontal sliding sum the global topology."""
-    _require_box(rule)
+    so the horizontal wrap is globally correct). The per-axis closure
+    split is exact for both neighborhoods: every vertical shift uses DEAD
+    on the slab, every horizontal sliding sum the global topology."""
     r = rule.radius
-    col = bit_sliced_sum(
-        [vshift(slab, d, Topology.DEAD) for d in range(-r, r + 1)])
-    counts = _sliding_sum_bs(col, 2 * r + 1, topology)
+    counts = neighborhood_counts_packed(slab, rule, Topology.DEAD, topology)
     return _apply_intervals(slab[r:-r], [c[r:-r] for c in counts], rule)
 
 
@@ -225,11 +258,12 @@ def step_ltl_packed_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
     ``ext`` is (h + 2r, wp + 2): r halo *rows* top/bottom and one halo
     *word* (32 >= r cells) left/right, materialised by the caller (the
     sharded runner's ppermute exchange). Counts are computed with DEAD
-    closure on the slab — every interior cell's (2r+1)² box lies inside
-    the ext, so the closure never touches a real contribution."""
-    _require_box(rule)
+    closure on the slab — every interior cell's neighborhood (box or
+    diamond) lies inside the ext, so the closure never touches a real
+    contribution."""
     r = rule.radius
-    counts = [c[r:-r, 1:-1] for c in box_counts_packed(ext, r, Topology.DEAD)]
+    counts = [c[r:-r, 1:-1] for c in neighborhood_counts_packed(
+        ext, rule, Topology.DEAD, Topology.DEAD)]
     return _apply_intervals(ext[r:-r, 1:-1], counts, rule)
 
 

@@ -385,9 +385,6 @@ def make_ltl_pallas_slab_step(
     caller crops ``out[r*gens:-r*gens]``. The radius-r twin of
     :func:`make_pallas_slab_step`, same ``dead_band`` SMEM edge-code
     contract; shard_map callers need ``check_vma=False``."""
-    from .packed_ltl import _require_box
-
-    _require_box(rule)
     He, Wp = ext_shape
     g = int(gens)
     hr = rule.radius * g
@@ -409,9 +406,10 @@ def make_ltl_pallas_slab_step(
                             slab_mode=True, dead_band=dead_band)
 
 
-# the bit-sliced box sum holds ~7 count planes of the slab alongside the
-# revolving buffers; budget them (vs the 3x3 kernel's lone carry network)
-_LTL_VMEM_PLANES = 7
+# the bit-sliced window sum (box or plane-truncated diamond) holds up to
+# ~8 count planes of the slab alongside the revolving buffers; budget
+# them (vs the 3x3 kernel's lone carry network)
+_LTL_VMEM_PLANES = 8
 
 
 def _ltl_vmem_bytes(bh: int, hr: int, Wp: int) -> int:
@@ -421,13 +419,12 @@ def _ltl_vmem_bytes(bh: int, hr: int, Wp: int) -> int:
 
 def ltl_supported(shape, rule, *, on_tpu: bool,
                   gens_per_call: Optional[int] = None) -> bool:
-    """Whether the LtL kernel can run this packed (H, Wp) shape: Moore
-    rule; natively also lane/sublane alignment; and (both modes) a block
-    decomposition with blocks >= the r·g halo within the VMEM budget —
-    a grid shorter than the halo has no decomposition even in interpret
-    mode, and the engine's fallback must know that up front."""
-    if rule.neighborhood != "M":
-        return False
+    """Whether the LtL kernel can run this packed (H, Wp) shape (both
+    neighborhoods — the diamond sum is per-row separable): natively
+    lane/sublane alignment; and (both modes) a block decomposition with
+    blocks >= the r·g halo within the VMEM budget — a grid shorter than
+    the halo has no decomposition even in interpret mode, and the
+    engine's fallback must know that up front."""
     H, Wp = shape
     g = gens_per_call or DEFAULT_GENS_PER_CALL
     hr = rule.radius * g
@@ -455,10 +452,8 @@ def make_ltl_pallas_step(
     call — the radius-r twin of :func:`make_pallas_step`. Temporal
     blocking pays 2·r·g redundant halo rows per block per call, so the
     HBM-traffic win per generation is the same ~g× as the 3x3 kernel
-    while the compute per cell is the (2r+1)² box network."""
-    from .packed_ltl import _require_box
-
-    _require_box(rule)
+    while the compute per cell is the rule's bit-sliced window network
+    (box or diamond)."""
     H, Wp = shape
     g = gens_per_call or DEFAULT_GENS_PER_CALL
     hr = rule.radius * g

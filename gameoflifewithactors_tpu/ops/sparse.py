@@ -28,9 +28,9 @@ flags … rather than a true sparse format, which stays XLA-friendly"):
 Single-device, both topologies: for DEAD the zero ring *is* the boundary;
 for TORUS the ring is refreshed with wrapped interior edges every
 generation and the activity dilation wraps (seam-crossing ships work).
-Serves life-like bitboards, Generations plane stacks, and Moore-box LtL
-(the bit-sliced packed window step). The sharded form lives in
-parallel/sharded.py.
+Serves life-like bitboards, Generations plane stacks, and radius-r LtL
+in both neighborhoods (the bit-sliced packed window step). The sharded
+form lives in parallel/sharded.py.
 """
 
 from __future__ import annotations
@@ -48,7 +48,7 @@ from .stencil import Topology
 
 def _step_window(window, rule):
     """One generation of a halo-extended window in any layout: a
-    (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL Moore) or a
+    (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL) or a
     (b, tr+2, tw+2) Generations bit-plane stack (leading plane axis)."""
     from ..models.ltl import LtLRule
 
@@ -78,7 +78,7 @@ def _wake_dilation(rule, tile_rows: int, tile_words: int) -> Tuple[int, int]:
 
 def _rule_halo(rule) -> Tuple[int, int]:
     """The zero-ring depth a rule's windowed step needs: (rows, words).
-    3x3 families use (1, 1); radius-r LtL Moore uses (r, 1) — its packed
+    3x3 families use (1, 1); radius-r LtL uses (r, 1) — its packed
     step reads r halo rows but only one 32-cell halo word (r <= 7)."""
     from ..models.ltl import LtLRule
 
@@ -378,13 +378,6 @@ class SparseEngineState:
                 "each generation, so nothing ever sleeps — use the packed "
                 "backend"
             )
-        from ..models.ltl import LtLRule
-
-        if isinstance(rule, LtLRule) and rule.neighborhood != "M":
-            raise ValueError(
-                f"sparse LtL serves Moore (box) neighborhoods only — the "
-                f"windowed step is the bit-sliced packed path; diamond "
-                f"rules ({rule.notation}) run on the dense backend")
         self.rule = rule
         self.tile_rows = tile_rows
         self.tile_words = tile_words

@@ -234,14 +234,28 @@ class TestVonNeumann:
         with pytest.raises(ValueError, match="outside 0..25"):
             LtLRule(radius=3, born=(0, 30), survive=(1, 2), neighborhood="N")
 
-    def test_packed_path_rejects_diamond(self):
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    @pytest.mark.parametrize("notation", [
+        "R2,C0,M1,S2..6,B3..5,NN",
+        "R1,C0,M0,S2..3,B2..2,NN",
+        "R4,C0,M1,S10..22,B12..17,NN",
+    ])
+    def test_packed_diamond_matches_dense(self, notation, topology):
+        """The packed path serves diamond rules now (per-row-separable
+        sums): bit-identity against the dense prefix-sum path."""
         from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
         from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
 
-        rule = parse_ltl("R2,C0,M1,S2..6,B3..5,NN")
-        p = bitpack.pack(jnp.zeros((8, 32), jnp.uint8))
-        with pytest.raises(ValueError, match="Moore-box"):
-            multi_step_ltl_packed(p, 1, rule=rule)
+        rule = parse_ltl(notation)
+        rng = np.random.default_rng(61)
+        grid = rng.integers(0, 2, size=(48, 96), dtype=np.uint8)
+        want = multi_step_ltl(jnp.asarray(grid), 6, rule=rule,
+                              topology=topology)
+        got = bitpack.unpack(multi_step_ltl_packed(
+            jnp.asarray(bitpack.pack_np(grid)), 6, rule=rule,
+            topology=topology))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
     def test_engine_and_sharded_dense_path(self):
         from gameoflifewithactors_tpu import Engine

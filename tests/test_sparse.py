@@ -676,15 +676,23 @@ class TestLtLSparse:
         from gameoflifewithactors_tpu.models.ltl import LtLRule
         from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
 
-        diamond = parse_any("R2,C0,M0,S6..11,B6..9,NN")
-        with pytest.raises(ValueError, match="Moore"):
-            SparseEngineState(jnp.zeros((32, 1), jnp.uint32), diamond)
         b0_ltl = LtLRule(radius=2, born=(0, 3), survive=(4, 9))
         with pytest.raises(ValueError, match="birth-from-nothing"):
             SparseEngineState(jnp.zeros((32, 1), jnp.uint32), b0_ltl)
-        with pytest.raises(ValueError, match="Moore rule and a width"):
-            Engine(np.zeros((32, 32), np.uint8),
+        with pytest.raises(ValueError, match="width divisible by 32"):
+            Engine(np.zeros((32, 48), np.uint8),
                    "R2,C0,M0,S6..11,B6..9,NN", backend="sparse")
+        # diamond rules ride the sparse windows now (packed diamond sums)
+        rng2 = np.random.default_rng(67)
+        dgrid = np.zeros((64, 96), np.uint8)
+        dgrid[20:40, 30:60] = rng2.integers(0, 2, size=(20, 30))
+        dsp = Engine(dgrid, "R2,C0,M0,S6..11,B6..9,NN", backend="sparse",
+                     topology=Topology.DEAD)
+        dref = Engine(dgrid, "R2,C0,M0,S6..11,B6..9,NN", backend="dense",
+                      topology=Topology.DEAD)
+        dsp.step(8)
+        dref.step(8)
+        np.testing.assert_array_equal(dsp.snapshot(), dref.snapshot())
 
         # engine facade: sparse bosco == dense bosco
         rng = np.random.default_rng(3)
