@@ -221,14 +221,16 @@ def run_bench(args) -> None:
               and ltl_supported((side, side // 32), rule,
                                 on_tpu=platform == "tpu"))
         if not ok:
-            _route_rule(platform == "tpu", "bit-sliced packed")
+            _route_rule(platform == "tpu" and rule.states == 2,
+                        "bit-sliced packed")
     elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse"):
         # LtL: bit-sliced packed path on TPU (or when explicitly
         # requested), byte path elsewhere (2.4x faster under CPU XLA —
-        # engine routing); both neighborhoods pack. An explicit sparse
-        # request passes through to the activity-tiled engine.
-        _route_rule(explicitly_packed or platform == "tpu",
-                    "bit-sliced packed")
+        # engine routing); both neighborhoods pack, binary states only
+        # (C>=3 decays on the byte path). An explicit sparse request
+        # passes through to the activity-tiled engine.
+        _route_rule((explicitly_packed or platform == "tpu")
+                    and rule.states == 2, "bit-sliced packed")
 
     def sync(x) -> int:
         """Force completion: block (a no-op on the tunnel), then fetch a

@@ -150,17 +150,19 @@ class Engine:
         _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
         # sparse LtL rides the same bit-sliced packed windows and the
         # pallas LtL kernel the same packed layout, so all three share the
-        # packed gate (word-divisible width; both neighborhoods — the
-        # diamond sum is per-row separable, ops/packed_ltl.py)
+        # packed gate (word-divisible width and binary states; both
+        # neighborhoods — the diamond sum is per-row separable,
+        # ops/packed_ltl.py; multi-state C>=3 decays on the byte path)
         self._ltl_packed = (self._ltl
                             and backend in ("packed", "sparse", "pallas")
-                            and _packs)
+                            and _packs and self.rule.states == 2)
         if self._ltl and backend == "sparse" and not self._ltl_packed:
             # an explicit sparse request that sparse cannot serve must not
             # silently become a dense run
             raise ValueError(
-                f"sparse LtL needs a width divisible by 32, got "
-                f"{self.rule.notation} on {self.shape}; use backend='dense'")
+                f"sparse LtL needs a binary (C0/C2) rule and a width "
+                f"divisible by 32, got {self.rule.notation} on "
+                f"{self.shape}; use backend='dense'")
         if (self._ltl and backend in ("packed", "pallas")
                 and not self._ltl_packed):
             # the bit-sliced/kernel paths can't serve this shape (width
@@ -175,14 +177,14 @@ class Engine:
                 raise ValueError(
                     f"gens_per_exchange={gens_per_exchange} needs the LtL "
                     f"band kernel, but {self.rule.notation} on {self.shape} "
-                    "cannot take the packed path (word-divisible widths "
-                    "only)")
+                    "cannot take the packed path (binary C0/C2 rules with "
+                    "word-divisible widths only)")
             if explicit_packed or backend == "pallas":
                 warnings.warn(
                     f"packed/pallas LtL unavailable for {self.rule.notation} "
-                    f"on {self.shape} over {_ny} mesh column(s) "
-                    "(word-divisible shard widths only); running the dense "
-                    "byte path",
+                    f"on {self.shape} over {_ny} mesh column(s) (binary "
+                    "C0/C2 rules with word-divisible shard widths only); "
+                    "running the dense byte path",
                     stacklevel=3,
                 )
             self.backend = backend = "dense"
@@ -524,7 +526,8 @@ class Engine:
             shape = np.shape(grid)
             ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
             if (on_tpu and len(shape) == 2
-                    and shape[1] % (bitpack.WORD * ny) == 0):
+                    and shape[1] % (bitpack.WORD * ny) == 0
+                    and self.rule.states == 2):
                 return "packed"
             return "dense"
         if self._generations:
@@ -682,30 +685,31 @@ class Engine:
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total).
 
-        For Generations rules only state 1 is *alive* — dying states occupy
-        space but are not population (they do not excite neighbors)."""
+        For multi-state families (Generations; LtL with C >= 3) only
+        state 1 is *alive* — dying states occupy space but are not
+        population (they do not excite neighbors)."""
         if self._packed:
             return bitpack.population(self.state)
         if self._gen_packed:
             from .ops.packed_generations import population_packed_generations
 
             return population_packed_generations(self.state)
-        cells = (self._state == 1) if self._generations else self._state
+        multistate = getattr(self.rule, "states", 2) > 2
+        cells = (self._state == 1) if multistate else self._state
         return int(np.asarray(jnp.sum(cells, axis=-1, dtype=jnp.uint32)).sum())
 
     # -- state injection (checkpoint restore, pattern editing) ---------------
 
     def _validate_states(self, np_grid: np.ndarray) -> None:
         top = int(np_grid.max()) if np_grid.size else 0
-        if self._generations and top >= self.rule.states:
+        # one rule for every family: Generations and multi-state LtL carry
+        # rule.states; binary families allow {0, 1}
+        nstates = getattr(self.rule, "states", 2)
+        if top >= nstates:
             raise ValueError(
                 f"grid holds state {top} but rule {self.rule.notation} "
-                f"has only states 0..{self.rule.states - 1}"
-            )
-        if not self._generations and top > 1:
-            raise ValueError(
-                f"grid holds value {top} but rule {self.rule.notation} "
-                "is binary: cells must be 0 or 1"
+                + (f"has only states 0..{nstates - 1}" if nstates > 2
+                   else "is binary: cells must be 0 or 1")
             )
 
     def set_grid(self, grid, generation: Optional[int] = None) -> None:

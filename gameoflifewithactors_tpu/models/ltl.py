@@ -7,10 +7,12 @@ is a separable pair of log-tree sliding-window sums in int32 on the VPU
 and replaced), alongside the bitwise SWAR path the 3×3 rules use.
 
 Notation (Golly's LtL form): ``R5,C0,M1,S34..58,B34..45`` —
-radius R, states C (only C0/C2 = binary supported here), M1 counts the
-center cell itself in the survival window (M0 excludes it), S/B are
-inclusive count intervals. Named rules: "bosco" (the classic), "bugs",
-"majority" (radius-4 majority vote).
+radius R, states C (C0/C2 = binary; C>=3 adds Generations-style dying
+states: alive cells failing survival decay through 2..C-1 instead of
+dying outright, and dying cells neither excite neighbors nor take
+births), M1 counts the center cell itself in the survival window (M0
+excludes it), S/B are inclusive count intervals. Named rules: "bosco"
+(the classic), "bugs", "majority" (radius-4 majority vote).
 """
 
 from __future__ import annotations
@@ -25,15 +27,18 @@ MAX_RADIUS = 7  # policy cap (int32 tree is exact at any radius): keeps
 
 @dataclasses.dataclass(frozen=True)
 class LtLRule:
-    """Binary Larger-than-Life: interval birth/survival over a radius-r
+    """Larger-than-Life: interval birth/survival over a radius-r
     neighborhood — Moore box ("M", Golly's NM) or von Neumann diamond
-    ("N", Golly's NN, |dx|+|dy| <= r)."""
+    ("N", Golly's NN, |dx|+|dy| <= r). ``states == 2`` is the classic
+    binary family; ``states >= 3`` adds Generations-style decay (state 1
+    alive, 2..states-1 dying and non-exciting)."""
 
     radius: int
     born: Tuple[int, int]       # inclusive [lo, hi]
     survive: Tuple[int, int]    # inclusive [lo, hi]
     middle: bool = True         # M1: a live cell counts itself in its window
     neighborhood: str = "M"     # "M" box | "N" von Neumann diamond
+    states: int = 2             # 2 = binary; >= 3 = dying states 2..C-1
 
     def __post_init__(self):
         if not 1 <= self.radius <= MAX_RADIUS:
@@ -45,6 +50,9 @@ class LtLRule:
             raise ValueError(
                 f"neighborhood must be 'M' (Moore box) or 'N' (von Neumann "
                 f"diamond), got {self.neighborhood!r}")
+        if not 2 <= self.states <= 256:
+            raise ValueError(
+                f"states must be 2..256 (uint8 cells), got {self.states}")
         full = self.window_size
         for name, (lo, hi) in (("born", self.born), ("survive", self.survive)):
             if not (0 <= lo <= hi <= full):
@@ -63,7 +71,8 @@ class LtLRule:
     @property
     def notation(self) -> str:
         return (
-            f"R{self.radius},C0,M{int(self.middle)},"
+            f"R{self.radius},C{0 if self.states == 2 else self.states},"
+            f"M{int(self.middle)},"
             f"S{self.survive[0]}..{self.survive[1]},"
             f"B{self.born[0]}..{self.born[1]}"
             + ("" if self.neighborhood == "M" else ",NN")
@@ -102,16 +111,14 @@ def parse_ltl(spec: "str | LtLRule") -> LtLRule:
             f"not a Larger-than-Life rule: {spec!r} (want "
             f"'R5,C0,M1,S34..58,B34..45' or one of {sorted(LTL_REGISTRY)})"
         )
-    if m.group("c") not in ("0", "2"):
-        raise ValueError(
-            f"only binary LtL supported (C0/C2), got C{m.group('c')}"
-        )
+    c = int(m.group("c"))
     return LtLRule(
         radius=int(m.group("r")),
         born=(int(m.group("b1")), int(m.group("b2"))),
         survive=(int(m.group("s1")), int(m.group("s2"))),
         middle=m.group("m") == "1",
         neighborhood=(m.group("n") or "m").upper(),
+        states=2 if c in (0, 1, 2) else c,  # Golly: C0/C1/C2 all binary
     )
 
 

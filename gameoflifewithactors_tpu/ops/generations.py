@@ -19,6 +19,22 @@ from ._jit import optionally_donated
 from .stencil import Topology, _pad_mode, neighbor_counts_ext
 
 
+def decay_select(state: jax.Array, born: jax.Array, keep: jax.Array,
+                 states: int) -> jax.Array:
+    """Branch-free multi-state transition shared by the Generations and
+    C>=3 LtL families: dead -> 1 iff born, alive -> 1 iff keep, everything
+    else counts up and states-1 wraps to 0 (dying cells decay; an alive
+    cell failing survival starts decaying at 2). The increment runs in
+    int32 so ``states == 256`` (the uint8 ceiling) cannot overflow the
+    Python-scalar-vs-uint8 cast."""
+    aged = ((state.astype(jnp.int32) + 1) % states).astype(jnp.uint8)
+    return jnp.where(
+        state == 0,
+        jnp.where(born, jnp.uint8(1), jnp.uint8(0)),
+        jnp.where((state == 1) & keep, jnp.uint8(1), aged),
+    ).astype(jnp.uint8)
+
+
 def step_generations_ext(ext: jax.Array, rule: GenRule) -> jax.Array:
     """One generation from a halo-extended (h+2, w+2) uint8 tile."""
     state = ext[1:-1, 1:-1]
@@ -26,14 +42,7 @@ def step_generations_ext(ext: jax.Array, rule: GenRule) -> jax.Array:
     counts = neighbor_counts_ext((ext == 1).astype(jnp.uint8)).astype(jnp.uint16)
     born = ((jnp.uint16(rule.birth_mask) >> counts) & 1).astype(bool)
     keep = ((jnp.uint16(rule.survive_mask) >> counts) & 1).astype(bool)
-    is_dead = state == 0
-    is_alive = state == 1
-    aged = ((state + 1) % rule.states).astype(state.dtype)  # dying counts up, C-1 -> 0
-    return jnp.where(
-        is_dead,
-        jnp.where(born, jnp.uint8(1), jnp.uint8(0)),
-        jnp.where(is_alive & keep, jnp.uint8(1), aged),
-    ).astype(jnp.uint8)
+    return decay_select(state, born, keep, rule.states)
 
 
 @optionally_donated("state")

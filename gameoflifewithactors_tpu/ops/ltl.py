@@ -95,17 +95,29 @@ def diamond_sums_ext(ext: jax.Array, radius: int) -> jax.Array:
 
 
 def step_ltl_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
-    """One generation from a halo-extended (h+2r, w+2r) uint8 tile."""
+    """One generation from a halo-extended (h+2r, w+2r) uint8 tile.
+
+    ``states == 2``: the classic binary family, window sums straight over
+    the 0/1 grid. ``states >= 3`` (Golly's C parameter): only state 1
+    excites, births land on dead (0) cells only, and an alive cell
+    failing its survival interval decays through 2..states-1 before dying
+    — the Generations select applied to LtL window counts."""
     r = rule.radius
     state = ext[r:-r, r:-r]
-    sums = (box_sums_ext(ext, r) if rule.neighborhood == "M"
-            else diamond_sums_ext(ext, r))
-    count = sums - (0 if rule.middle else state.astype(jnp.int32))
-    alive = state.astype(bool)
+    multistate = rule.states > 2
+    src = (ext == 1).astype(jnp.uint8) if multistate else ext
+    sums = (box_sums_ext(src, r) if rule.neighborhood == "M"
+            else diamond_sums_ext(src, r))
+    is_alive = state == 1
+    count = sums - (0 if rule.middle else is_alive.astype(jnp.int32))
     (b1, b2), (s1, s2) = rule.born, rule.survive
-    born = (~alive) & (count >= b1) & (count <= b2)
-    keep = alive & (count >= s1) & (count <= s2)
-    return (born | keep).astype(jnp.uint8)
+    born = (state == 0) & (count >= b1) & (count <= b2)
+    keep = is_alive & (count >= s1) & (count <= s2)
+    if not multistate:
+        return (born | keep).astype(jnp.uint8)
+    from .generations import decay_select
+
+    return decay_select(state, born, keep, rule.states)
 
 
 @optionally_donated("state")

@@ -232,8 +232,18 @@ def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> ja
     return born | keep
 
 
+def _require_binary(rule: LtLRule) -> None:
+    """The packed layout is one bit per cell: multi-state (C >= 3) LtL
+    needs the byte path (ops/ltl.py dense step handles the decay)."""
+    if rule.states != 2:
+        raise ValueError(
+            f"the packed LtL path is binary (1 bit/cell); {rule.notation} "
+            f"has {rule.states} states — use backend='dense'")
+
+
 def step_ltl_packed(p: jax.Array, rule: LtLRule, topology: Topology) -> jax.Array:
     """One generation on a (H, W/32) packed binary grid (box or diamond)."""
+    _require_binary(rule)
     return _apply_intervals(
         p, neighborhood_counts_packed(p, rule, topology, topology), rule)
 
@@ -247,6 +257,7 @@ def step_ltl_packed_slab(slab: jax.Array, rule: LtLRule,
     so the horizontal wrap is globally correct). The per-axis closure
     split is exact for both neighborhoods: every vertical shift uses DEAD
     on the slab, every horizontal sliding sum the global topology."""
+    _require_binary(rule)
     r = rule.radius
     counts = neighborhood_counts_packed(slab, rule, Topology.DEAD, topology)
     return _apply_intervals(slab[r:-r], [c[r:-r] for c in counts], rule)
@@ -261,6 +272,7 @@ def step_ltl_packed_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
     closure on the slab — every interior cell's neighborhood (box or
     diamond) lies inside the ext, so the closure never touches a real
     contribution."""
+    _require_binary(rule)
     r = rule.radius
     counts = [c[r:-r, 1:-1] for c in neighborhood_counts_packed(
         ext, rule, Topology.DEAD, Topology.DEAD)]

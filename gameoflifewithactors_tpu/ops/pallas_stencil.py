@@ -420,11 +420,13 @@ def _ltl_vmem_bytes(bh: int, hr: int, Wp: int) -> int:
 def ltl_supported(shape, rule, *, on_tpu: bool,
                   gens_per_call: Optional[int] = None) -> bool:
     """Whether the LtL kernel can run this packed (H, Wp) shape (both
-    neighborhoods — the diamond sum is per-row separable): natively
-    lane/sublane alignment; and (both modes) a block decomposition with
-    blocks >= the r·g halo within the VMEM budget — a grid shorter than
-    the halo has no decomposition even in interpret mode, and the
-    engine's fallback must know that up front."""
+    neighborhoods — the diamond sum is per-row separable; binary rules
+    only, 1 bit/cell): natively lane/sublane alignment; and (both modes)
+    a block decomposition with blocks >= the r·g halo within the VMEM
+    budget — a grid shorter than the halo has no decomposition even in
+    interpret mode, and the engine's fallback must know that up front."""
+    if rule.states != 2:
+        return False
     H, Wp = shape
     g = gens_per_call or DEFAULT_GENS_PER_CALL
     hr = rule.radius * g

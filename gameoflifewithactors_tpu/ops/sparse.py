@@ -371,6 +371,13 @@ class SparseEngineState:
         # mostly-sleeping universe never pays a 256-tile window batch per
         # generation for 6 active tiles.
         self._adaptive = capacity is None
+        from ..models.ltl import LtLRule
+
+        if isinstance(rule, LtLRule) and rule.states != 2:
+            raise ValueError(
+                f"sparse LtL is binary (the windows are 1-bit packed); "
+                f"{rule.notation} has {rule.states} states — use the "
+                "dense backend")
         if _births_from_nothing(rule):
             raise ValueError(
                 f"sparse backend cannot run birth-from-nothing rules "

@@ -44,11 +44,13 @@ def test_parse_notation_and_names():
     assert BOSCO.notation == "R5,C0,M1,S34..58,B34..45"
     assert parse_any("bosco") == BOSCO
     assert isinstance(parse_any("R2,C0,M0,S3..8,B5..7"), LtLRule)
+    # C3 parses now (multi-state LtL); C257 exceeds the uint8 cap
     for bad in ("R5,C0,M1,S34..58", "R0,C0,M1,S1..2,B1..2",
-                "R8,C0,M1,S1..2,B1..2", "R5,C3,M1,S1..2,B1..2",
+                "R8,C0,M1,S1..2,B1..2", "R5,C257,M1,S1..2,B1..2",
                 "R2,C0,M1,S9..3,B1..2"):
         with pytest.raises(ValueError):
             parse_ltl(bad)
+    assert parse_ltl("R5,C3,M1,S1..2,B1..2").states == 3
 
 
 def test_radius1_m0_interval_reduces_to_life_like():
@@ -270,3 +272,115 @@ class TestVonNeumann:
         single.step(6)
         sharded_e.step(6)
         np.testing.assert_array_equal(single.snapshot(), sharded_e.snapshot())
+
+
+class TestMultiStateLtL:
+    """Golly's C >= 3: Generations-style decay over LtL windows — only
+    state 1 excites, births land on dead cells, failed survivors decay
+    through 2..C-1. Dense path only (the packed layout is 1 bit/cell)."""
+
+    @staticmethod
+    def _oracle(grid, rule, n, wrap):
+        g = np.asarray(grid).astype(np.int32)
+        r = rule.radius
+        for _ in range(n):
+            alive = (g == 1).astype(np.int32)
+            pad = (np.pad(alive, r, mode="wrap") if wrap
+                   else np.pad(alive, r))
+            H, W = g.shape
+            counts = np.zeros_like(g)
+            for dy in range(-r, r + 1):
+                for dx in range(-r, r + 1):
+                    if rule.neighborhood == "N" and abs(dy) + abs(dx) > r:
+                        continue
+                    counts += pad[r + dy:r + dy + H, r + dx:r + dx + W]
+            if not rule.middle:
+                counts -= alive
+            (b1, b2), (s1, s2) = rule.born, rule.survive
+            born = (g == 0) & (counts >= b1) & (counts <= b2)
+            keep = (g == 1) & (counts >= s1) & (counts <= s2)
+            nxt = np.where(g == 0, np.where(born, 1, 0),
+                           np.where(keep, 1, (g + 1) % rule.states))
+            g = nxt.astype(np.int32)
+        return g.astype(np.uint8)
+
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    @pytest.mark.parametrize("notation", [
+        "R2,C4,M1,S3..8,B5..9",
+        "R3,C5,M0,S10..20,B14..19,NN",
+        "R1,C3,M0,S2..3,B2..2",       # r=1 diamond-of-the-mind: brain-ish
+    ])
+    def test_dense_matches_oracle(self, notation, topology):
+        from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+
+        rule = parse_ltl(notation)
+        assert rule.states > 2 and rule.notation == notation.upper().replace(" ", "")
+        rng = np.random.default_rng(73)
+        grid = rng.integers(0, rule.states, size=(40, 56), dtype=np.uint8)
+        want = self._oracle(grid, rule, 5, wrap=topology is Topology.TORUS)
+        got = np.asarray(multi_step_ltl(jnp.asarray(grid), 5, rule=rule,
+                                        topology=topology))
+        np.testing.assert_array_equal(got, want)
+
+    def test_engine_facade_and_gates(self):
+        from gameoflifewithactors_tpu import Engine
+
+        rule = parse_ltl("R2,C4,M1,S3..8,B5..9")
+        rng = np.random.default_rng(79)
+        grid = rng.integers(0, 4, size=(48, 64), dtype=np.uint8)
+        e = Engine(grid, rule)                       # auto -> dense
+        assert e.backend == "dense"
+        e.step(4)
+        want = self._oracle(grid, rule, 4, wrap=True)
+        np.testing.assert_array_equal(e.snapshot(), want)
+        # population counts ONLY alive (state 1) cells
+        assert e.population() == int((want == 1).sum())
+        # state validation knows the rule's state count
+        with pytest.raises(ValueError, match="states 0..3"):
+            Engine(np.full((16, 32), 4, np.uint8), rule)
+        # binary-only fast paths reject clearly
+        with pytest.raises(ValueError, match="binary"):
+            Engine(grid, rule, backend="sparse")
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        with pytest.raises(ValueError, match="1 bit/cell"):
+            multi_step_ltl_packed(
+                bitpack.pack(jnp.zeros((8, 32), jnp.uint8)), 1, rule=rule)
+
+    def test_sharded_dense_multistate(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        rule = parse_ltl("R2,C4,M1,S3..8,B5..9")
+        rng = np.random.default_rng(83)
+        grid = rng.integers(0, 4, size=(32, 64), dtype=np.uint8)
+        single = Engine(grid, rule)
+        sharded_e = Engine(grid, rule, mesh=mesh_lib.make_mesh((2, 4)))
+        single.step(5)
+        sharded_e.step(5)
+        np.testing.assert_array_equal(single.snapshot(), sharded_e.snapshot())
+
+    def test_notation_and_parse_roundtrip(self):
+        r = parse_ltl("R2,C4,M1,S3..8,B5..9")
+        assert r.states == 4 and parse_ltl(r.notation) == r
+        # C0/C1/C2 all mean binary
+        assert parse_ltl("R2,C1,M1,S3..8,B5..9").states == 2
+        with pytest.raises(ValueError, match="2..256"):
+            from gameoflifewithactors_tpu.models.ltl import LtLRule
+
+            LtLRule(radius=2, born=(3, 5), survive=(3, 5), states=300)
+
+    def test_states_256_ceiling_steps(self):
+        # the uint8 ceiling: the decay increment must not overflow the
+        # Python-scalar-vs-uint8 cast (review finding; shared decay_select)
+        from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+
+        rule = parse_ltl("R1,C256,M0,S2..3,B3..3")
+        grid = np.zeros((16, 16), np.uint8)
+        grid[4, 4:7] = 1          # blinker-ish line; failures decay to 2
+        grid[10, 10] = 255        # top dying state wraps to 0
+        out = np.asarray(multi_step_ltl(jnp.asarray(grid), 1, rule=rule,
+                                        topology=Topology.DEAD))
+        assert out[10, 10] == 0
+        assert out.max() <= 255
