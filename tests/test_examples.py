@@ -46,3 +46,19 @@ def test_wolfram_sierpinski(capsys):
     assert "W90: 16 generations" in out
     # generation 16 of rule 90 has exactly 2 live cells (2^popcount(16))
     assert out.splitlines()[16].count("#") == 2
+
+
+def test_ltl_zoo_runs(capsys):
+    from examples.ltl_zoo import main
+
+    main(["--side", "64", "--gens", "6"])
+    out = capsys.readouterr().out
+    assert out.count("pop") == 3 and "decay" in out
+
+
+def test_long_row_runs(capsys):
+    from examples.long_row import main
+
+    main(["--cells", "2048", "--gens", "64", "--rules", "W30,W184"])
+    out = capsys.readouterr().out
+    assert "W30" in out and "W184" in out and "8 devices" in out
