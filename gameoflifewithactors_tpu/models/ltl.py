@@ -25,6 +25,24 @@ MAX_RADIUS = 7  # policy cap (int32 tree is exact at any radius): keeps
 # halo-exchange depth and window shapes modest on sharded meshes
 
 
+def _as_intervals(name, value) -> Tuple[Tuple[int, int], ...]:
+    """Normalize born/survive: a bare (lo, hi) int pair -> ((lo, hi),); a
+    tuple of pairs passes through; an empty tuple means 'never' (Golly
+    allows e.g. an empty survival list in HROT rules)."""
+    if isinstance(value, tuple) and not value:
+        return ()
+    if (isinstance(value, tuple) and len(value) == 2
+            and all(isinstance(v, int) for v in value)):
+        return (value,)
+    if (isinstance(value, tuple) and value
+            and all(isinstance(iv, tuple) and len(iv) == 2
+                    and all(isinstance(v, int) for v in iv) for iv in value)):
+        return value
+    raise ValueError(
+        f"{name} must be an inclusive (lo, hi) pair, a tuple of such "
+        f"intervals, or () for 'never', got {value!r}")
+
+
 @dataclasses.dataclass(frozen=True)
 class LtLRule:
     """Larger-than-Life: interval birth/survival over a radius-r
@@ -34,8 +52,8 @@ class LtLRule:
     alive, 2..states-1 dying and non-exciting)."""
 
     radius: int
-    born: Tuple[int, int]       # inclusive [lo, hi]
-    survive: Tuple[int, int]    # inclusive [lo, hi]
+    born: Tuple[int, int]       # (lo, hi) — or a tuple of such intervals
+    survive: Tuple[int, int]    # (lo, hi) — or a tuple of such intervals
     middle: bool = True         # M1: a live cell counts itself in its window
     neighborhood: str = "M"     # "M" box | "N" von Neumann diamond
     states: int = 2             # 2 = binary; >= 3 = dying states 2..C-1
@@ -54,12 +72,35 @@ class LtLRule:
             raise ValueError(
                 f"states must be 2..256 (uint8 cells), got {self.states}")
         full = self.window_size
-        for name, (lo, hi) in (("born", self.born), ("survive", self.survive)):
-            if not (0 <= lo <= hi <= full):
-                raise ValueError(
-                    f"{name} interval {lo}..{hi} outside 0..{full} "
-                    f"for radius {self.radius} neighborhood {self.neighborhood}"
-                )
+        for name in ("born", "survive"):
+            ivs = _as_intervals(name, getattr(self, name))
+            # canonicalize storage (bare pair when single, interval tuple
+            # otherwise — what the parser produces), so equal rules
+            # compare/hash equal no matter how they were constructed
+            object.__setattr__(self, name, ivs[0] if len(ivs) == 1 else ivs)
+            prev_hi = -2
+            for lo, hi in ivs:
+                if not (0 <= lo <= hi <= full):
+                    raise ValueError(
+                        f"{name} interval {lo}..{hi} outside 0..{full} "
+                        f"for radius {self.radius} neighborhood "
+                        f"{self.neighborhood}")
+                if lo <= prev_hi + 1:
+                    raise ValueError(
+                        f"{name} intervals must be sorted and disjoint "
+                        f"(non-adjacent), got {ivs}")
+                prev_hi = hi
+
+    @property
+    def born_intervals(self) -> Tuple[Tuple[int, int], ...]:
+        """``born`` as a tuple of inclusive (lo, hi) intervals — a single
+        pair (the classic LtL form) normalizes to a 1-tuple; HROT lists
+        pass through."""
+        return _as_intervals("born", self.born)
+
+    @property
+    def survive_intervals(self) -> Tuple[Tuple[int, int], ...]:
+        return _as_intervals("survive", self.survive)
 
     @property
     def window_size(self) -> int:
@@ -70,11 +111,14 @@ class LtLRule:
 
     @property
     def notation(self) -> str:
+        def ivs(vals) -> str:
+            return ",".join(f"{lo}..{hi}" for lo, hi in _as_intervals("", vals))
+
         return (
             f"R{self.radius},C{0 if self.states == 2 else self.states},"
             f"M{int(self.middle)},"
-            f"S{self.survive[0]}..{self.survive[1]},"
-            f"B{self.born[0]}..{self.born[1]}"
+            f"S{ivs(self.survive)},"
+            f"B{ivs(self.born)}"
             + ("" if self.neighborhood == "M" else ",NN")
         )
 
@@ -82,12 +126,11 @@ class LtLRule:
         return self.notation
 
 
-_LTL_RE = re.compile(
-    r"^R(?P<r>\d+),C(?P<c>\d+),M(?P<m>[01]),"
-    r"S(?P<s1>\d+)\.\.(?P<s2>\d+),B(?P<b1>\d+)\.\.(?P<b2>\d+)"
-    r"(?:,N(?P<n>[MN]))?$",
-    re.IGNORECASE,
-)
+_VALUE_RE = re.compile(r"^(\d+)(?:(?:\.\.|-)(\d+))?$")
+# shape sentinel for models.generations.parse_any dispatch: anything
+# starting "R<d>,C<d>," is this family's (classic LtL or HROT form);
+# full validation happens in parse_ltl
+_LTL_RE = re.compile(r"^r\d+,c\d+,", re.IGNORECASE)
 
 LTL_REGISTRY = {}
 
@@ -99,25 +142,74 @@ def _mk(spec: str, name: str) -> LtLRule:
 
 
 def parse_ltl(spec: "str | LtLRule") -> LtLRule:
+    """Parse the classic LtL form (``R5,C0,M1,S34..58,B34..45[,NN]``) or
+    Golly's HROT list form (``R2,C2,S6-9,B7-8[,NM]``) — S/B take
+    comma-separated values or inclusive ranges (``a``, ``a-b``, ``a..b``),
+    and an absent M token means M0 (HROT is outer-totalistic)."""
     if isinstance(spec, LtLRule):
         return spec
     key = spec.strip().lower().replace(" ", "")
     if key in LTL_REGISTRY:
         return LTL_REGISTRY[key]
-    # match the space-stripped key, so 'R5, C0, M1, S34..58, B34..45' parses
-    m = _LTL_RE.match(key)
-    if not m:
-        raise ValueError(
-            f"not a Larger-than-Life rule: {spec!r} (want "
-            f"'R5,C0,M1,S34..58,B34..45' or one of {sorted(LTL_REGISTRY)})"
-        )
-    c = int(m.group("c"))
+
+    def fail(why: str) -> ValueError:
+        return ValueError(
+            f"not a Larger-than-Life/HROT rule: {spec!r} ({why}; want "
+            f"'R5,C0,M1,S34..58,B34..45', 'R2,C2,S6-9,B7-8', or one of "
+            f"{sorted(LTL_REGISTRY)})")
+
+    tokens = key.split(",")
+    if len(tokens) < 4 or not tokens[0].startswith("r") \
+            or not tokens[1].startswith("c"):
+        raise fail("expected R...,C...,[M...,]S...,B...")
+    try:
+        radius = int(tokens[0][1:])
+        c = int(tokens[1][1:])
+    except ValueError:
+        raise fail("R and C take integers") from None
+    i = 2
+    middle = False  # HROT default: outer-totalistic (no M token)
+    if tokens[i].startswith("m"):
+        if tokens[i] not in ("m0", "m1"):
+            raise fail("M takes 0 or 1")
+        middle = tokens[i] == "m1"
+        i += 1
+
+    def values(lead: str, i: int):
+        """Collect the comma-separated interval list opened by token
+        ``lead`` + following bare-value tokens. A bare section token
+        (e.g. 'S' straight before 'B...') is Golly's empty list."""
+        if i >= len(tokens) or not tokens[i].startswith(lead):
+            raise fail(f"expected {lead.upper()} section")
+        ivs, first = [], tokens[i][1:]
+        i += 1
+        items = [first] if first else []
+        while i < len(tokens) and _VALUE_RE.match(tokens[i]):
+            items.append(tokens[i])
+            i += 1
+        for item in items:
+            m = _VALUE_RE.match(item)
+            if not m:
+                raise fail(f"bad {lead.upper()} value {item!r}")
+            lo = int(m.group(1))
+            ivs.append((lo, int(m.group(2)) if m.group(2) else lo))
+        return tuple(ivs), i
+
+    survive, i = values("s", i)
+    born, i = values("b", i)
+    neighborhood = "M"
+    if i < len(tokens):
+        if tokens[i] in ("nm", "nn"):
+            neighborhood = tokens[i][1].upper()
+            i += 1
+    if i != len(tokens):
+        raise fail(f"unexpected trailing tokens {tokens[i:]}")
     return LtLRule(
-        radius=int(m.group("r")),
-        born=(int(m.group("b1")), int(m.group("b2"))),
-        survive=(int(m.group("s1")), int(m.group("s2"))),
-        middle=m.group("m") == "1",
-        neighborhood=(m.group("n") or "m").upper(),
+        radius=radius,
+        born=born,          # __post_init__ canonicalizes single intervals
+        survive=survive,
+        middle=middle,
+        neighborhood=neighborhood,
         states=2 if c in (0, 1, 2) else c,  # Golly: C0/C1/C2 all binary
     )
 

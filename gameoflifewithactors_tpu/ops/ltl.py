@@ -110,9 +110,17 @@ def step_ltl_ext(ext: jax.Array, rule: LtLRule) -> jax.Array:
             else diamond_sums_ext(src, r))
     is_alive = state == 1
     count = sums - (0 if rule.middle else is_alive.astype(jnp.int32))
-    (b1, b2), (s1, s2) = rule.born, rule.survive
-    born = (state == 0) & (count >= b1) & (count <= b2)
-    keep = is_alive & (count >= s1) & (count <= s2)
+
+    def in_any(intervals):
+        hit = None
+        for lo, hi in intervals:
+            t = (count >= lo) & (count <= hi)
+            hit = t if hit is None else (hit | t)
+        # an empty interval list (Golly allows e.g. empty survival) = never
+        return jnp.zeros_like(state, dtype=bool) if hit is None else hit
+
+    born = (state == 0) & in_any(rule.born_intervals)
+    keep = is_alive & in_any(rule.survive_intervals)
     if not multistate:
         return (born | keep).astype(jnp.uint8)
     from .generations import decay_select

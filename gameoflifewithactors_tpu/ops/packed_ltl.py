@@ -223,12 +223,22 @@ def neighborhood_counts_packed(p: jax.Array, rule: LtLRule, v_topo: Topology,
 
 
 def _apply_intervals(p: jax.Array, counts: List[jax.Array], rule: LtLRule) -> jax.Array:
-    """Next-generation plane from the alive plane + bit-sliced box counts."""
+    """Next-generation plane from the alive plane + bit-sliced window
+    counts; born/survive may be HROT interval lists (OR-fold of the
+    bit-sliced comparator pairs)."""
     if not rule.middle:
-        counts = bs_sub_bit(counts, p)  # box sum >= p, no underflow
-    (b1, b2), (s1, s2) = rule.born, rule.survive
-    born = ~p & bs_ge(counts, b1) & ~bs_ge(counts, b2 + 1)
-    keep = p & bs_ge(counts, s1) & ~bs_ge(counts, s2 + 1)
+        counts = bs_sub_bit(counts, p)  # window sum >= p, no underflow
+
+    def in_any(intervals):
+        hit = None
+        for lo, hi in intervals:
+            t = bs_ge(counts, lo) & ~bs_ge(counts, hi + 1)
+            hit = t if hit is None else (hit | t)
+        # an empty interval list (Golly allows e.g. empty survival) = never
+        return jnp.zeros_like(p) if hit is None else hit
+
+    born = ~p & in_any(rule.born_intervals)
+    keep = p & in_any(rule.survive_intervals)
     return born | keep
 
 

@@ -93,7 +93,9 @@ def _births_from_nothing(rule) -> bool:
     from ..models.ltl import LtLRule
 
     if isinstance(rule, LtLRule):
-        return rule.born[0] == 0  # interval [lo, hi] over the box count
+        # interval list over the window count: births at count 0 mean an
+        # all-dead region births
+        return any(lo == 0 for lo, _ in rule.born_intervals)
     return 0 in rule.born
 
 

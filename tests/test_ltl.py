@@ -384,3 +384,117 @@ class TestMultiStateLtL:
                                         topology=Topology.DEAD))
         assert out[10, 10] == 0
         assert out.max() <= 255
+
+
+class TestHROTIntervalLists:
+    """Golly HROT form: S/B as comma-separated values or ranges
+    (``R2,C2,S6-9,B7-8``), no M token = outer-totalistic (M0); born and
+    survive become tuples of disjoint intervals honored by every path."""
+
+    def test_parse_and_notation(self):
+        r = parse_ltl("R2,C2,S2,4-6,B5,7..8")
+        assert r.survive_intervals == ((2, 2), (4, 6))
+        assert r.born_intervals == ((5, 5), (7, 8))
+        assert not r.middle and r.states == 2
+        # canonical notation round-trips through the parser losslessly
+        assert r.notation == "R2,C0,M0,S2..2,4..6,B5..5,7..8"
+        assert parse_ltl(r.notation) == r
+        # classic single-interval strings still canonicalize unchanged
+        assert parse_ltl("bosco").notation == "R5,C0,M1,S34..58,B34..45"
+        with pytest.raises(ValueError, match="sorted and disjoint"):
+            parse_ltl("R2,C2,S4-6,2,B7")     # out of order
+        with pytest.raises(ValueError, match="sorted and disjoint"):
+            parse_ltl("R2,C2,S2-4,5-6,B7")   # adjacent: should be one range
+        with pytest.raises(ValueError):
+            parse_ltl("R2,C2,B7")            # missing S section
+
+    def test_empty_survival_list_and_canonical_equality(self):
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        # Golly allows an empty list: nothing survives, only births happen
+        r = parse_ltl("R1,C2,S,B1-8")
+        assert r.survive_intervals == () and r.notation == "R1,C0,M0,S,B1..8"
+        assert parse_ltl(r.notation) == r
+        g = np.zeros((16, 32), np.uint8)
+        g[8, 8] = 1
+        out = np.asarray(multi_step_ltl(jnp.asarray(g), 1, rule=r,
+                                        topology=Topology.DEAD))
+        assert out[8, 8] == 0            # no survival interval at all
+        assert out.sum() == 8            # the 8 neighbors birthed
+        pk = np.asarray(bitpack.unpack(multi_step_ltl_packed(
+            bitpack.pack(jnp.asarray(g)), 1, rule=r, topology=Topology.DEAD)))
+        np.testing.assert_array_equal(pk, out)
+        # construction forms canonicalize: 1-tuple == bare pair (review
+        # finding — rule-keyed compile caches must not see two rules)
+        a = LtLRule(radius=2, born=((3, 5),), survive=((2, 3),))
+        b = LtLRule(radius=2, born=(3, 5), survive=(2, 3))
+        assert a == b and hash(a) == hash(b)
+
+    @staticmethod
+    def _oracle(g, rule, n, wrap):
+        import numpy as np
+
+        r = rule.radius
+        g = np.asarray(g).astype(np.int32)
+        for _ in range(n):
+            p = np.pad(g, r, mode="wrap") if wrap else np.pad(g, r)
+            cnt = np.zeros_like(g)
+            for dr in range(-r, r + 1):
+                ac = r if rule.neighborhood == "M" else r - abs(dr)
+                for dc in range(-ac, ac + 1):
+                    cnt += p[r + dr:p.shape[0] - r + dr,
+                             r + dc:p.shape[1] - r + dc]
+            if not rule.middle:
+                cnt -= g
+            in_b = np.zeros_like(g, dtype=bool)
+            for lo, hi in rule.born_intervals:
+                in_b |= (cnt >= lo) & (cnt <= hi)
+            in_s = np.zeros_like(g, dtype=bool)
+            for lo, hi in rule.survive_intervals:
+                in_s |= (cnt >= lo) & (cnt <= hi)
+            g = (((g == 0) & in_b) | ((g == 1) & in_s)).astype(np.int32)
+        return g.astype(np.uint8)
+
+    @pytest.mark.parametrize("topology", list(Topology), ids=lambda t: t.value)
+    @pytest.mark.parametrize("notation", [
+        "R2,C2,S6-9,12-15,B7-8",
+        "R3,C2,M1,S10..14,20..25,B14..19,NN",
+    ])
+    def test_dense_and_packed_match_oracle(self, notation, topology):
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        rule = parse_ltl(notation)
+        rng = np.random.default_rng(89)
+        g = rng.integers(0, 2, size=(40, 64), dtype=np.uint8)
+        want = self._oracle(g, rule, 4, topology is Topology.TORUS)
+        dense = np.asarray(multi_step_ltl(jnp.asarray(g), 4, rule=rule,
+                                          topology=topology))
+        np.testing.assert_array_equal(dense, want)
+        packed = np.asarray(bitpack.unpack(multi_step_ltl_packed(
+            bitpack.pack(jnp.asarray(g)), 4, rule=rule, topology=topology)))
+        np.testing.assert_array_equal(packed, want)
+
+    def test_engine_and_kernel_serve_interval_lists(self):
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.ops import bitpack
+        from gameoflifewithactors_tpu.ops.pallas_stencil import (
+            multi_step_ltl_pallas,
+        )
+        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+
+        rule = parse_ltl("R2,C2,S6-9,12-15,B7-8")
+        rng = np.random.default_rng(97)
+        g = rng.integers(0, 2, size=(64, 64), dtype=np.uint8)
+        a = Engine(g, rule, backend="packed")
+        b = Engine(g, rule, backend="dense")
+        a.step(5)
+        b.step(5)
+        np.testing.assert_array_equal(a.snapshot(), b.snapshot())
+        p = bitpack.pack(jnp.asarray(g))
+        want = multi_step_ltl_packed(p, 4, rule=rule, topology=Topology.TORUS)
+        got = multi_step_ltl_pallas(p, 4, rule=rule, topology=Topology.TORUS,
+                                    interpret=True, block_rows=16,
+                                    gens_per_call=2)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
