@@ -83,3 +83,49 @@ def test_multi_step_dynamic_n_no_recompile():
     avals = multi_step.jitted.lower(g, 3, rule=CONWAY).in_avals
     assert any(a.shape == () and "int" in a.dtype.name for a in jax.tree.leaves(avals))
     multi_step(g, 5, rule=CONWAY)  # different n: must not need a new lowering
+
+
+def test_auto_resolution_tpu_branches(monkeypatch):
+    """_resolve_auto's TPU-side routing never runs in CI (tests force the
+    CPU platform): pin it by faking the platform check. Resolution is
+    pure — no native compile happens here."""
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops import pallas_stencil
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    host = Engine(np.zeros((64, 64), np.uint8), "conway")  # CPU engine
+    monkeypatch.setattr(pallas_stencil, "default_interpret", lambda: False)
+
+    # 3x3 binary at the bench shape -> the native kernel
+    assert host._resolve_auto(np.zeros((16384, 16384), np.uint8), None,
+                              Topology.TORUS) == "pallas"
+    # lane-unaligned width (% 128 words fails) -> packed SWAR
+    assert host._resolve_auto(np.zeros((16384, 16000), np.uint8), None,
+                              Topology.TORUS) == "packed"
+    # word-unaligned width (% 32 fails): the early packed return
+    assert host._resolve_auto(np.zeros((16384, 16010), np.uint8), None,
+                              Topology.TORUS) == "packed"
+    # (nx, 1) band mesh -> pallas for BOTH topologies (round-3 DEAD support)
+    m = mesh_lib.make_mesh((8, 1))
+    for topo in (Topology.TORUS, Topology.DEAD):
+        assert host._resolve_auto(np.zeros((4096, 4096), np.uint8), m,
+                                  topo) == "pallas"
+    # 2D tile mesh cannot band -> packed
+    m22 = mesh_lib.make_mesh((2, 4))
+    assert host._resolve_auto(np.zeros((4096, 4096), np.uint8), m22,
+                              Topology.TORUS) == "packed"
+
+    # LtL on TPU: bit-sliced packed for binary (both neighborhoods),
+    # dense for multi-state decay
+    bosco = Engine(np.zeros((64, 64), np.uint8), "bosco", backend="dense")
+    assert bosco._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
+                               Topology.TORUS) == "packed"
+    diamond = Engine(np.zeros((64, 64), np.uint8),
+                     "R2,C0,M0,S6..11,B6..9,NN", backend="dense")
+    assert diamond._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
+                                 Topology.TORUS) == "packed"
+    multi = Engine(np.zeros((64, 64), np.uint8),
+                   parse_any("R2,C4,M1,S3..8,B5..9"), backend="dense")
+    assert multi._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
+                               Topology.TORUS) == "dense"
