@@ -170,3 +170,88 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = sharded.make_step_dense(engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state)
     return collective_permute_bytes(lowered.compile().as_text())
+
+
+def perfetto_summary(trace_path: str) -> dict:
+    """Measured device-activity summary from a perfetto/chrome trace
+    (``jax.profiler.start_trace(..., create_perfetto_trace=True)`` writes
+    ``perfetto_trace.json.gz``).
+
+    Per (process, thread) track: interval-union busy time (robust to the
+    nested/overlapping slices a profiler emits), the track's wall span,
+    and the top slice names by summed duration. Device tracks are the
+    ones whose process or thread name mentions the accelerator — on a
+    host-only capture there simply are none, and the caller can tell.
+    This turns the roofline story from arithmetic into measurement
+    (VERDICT round-2 item #6): measured busy seconds of the kernel's
+    device track is the denominator for the measured in-kernel rate.
+
+    ``device_busy_us``/``device_span_us`` describe the single busiest
+    device track, NOT a sum: TPU profiler dumps mirror one device's
+    activity across several stacked track layers (XLA Modules / XLA Ops /
+    step lines), so summing across them would count the same wall time
+    several times over and could push a duty cycle past 1.0.
+    """
+    import gzip
+    import json as _json
+
+    opener = gzip.open if trace_path.endswith(".gz") else open
+    with opener(trace_path, "rt") as f:
+        data = _json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+
+    proc_names: dict = {}
+    thread_names: dict = {}
+    slices: dict = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            if ev.get("name") == "process_name":
+                proc_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
+            elif ev.get("name") == "thread_name":
+                thread_names[(ev.get("pid"), ev.get("tid"))] = (
+                    ev.get("args", {}).get("name", ""))
+        elif ph == "X" and "dur" in ev:
+            key = (ev.get("pid"), ev.get("tid"))
+            slices.setdefault(key, []).append(
+                (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]),
+                 ev.get("name", "")))
+
+    tracks = []
+    for (pid, tid), evs in slices.items():
+        evs.sort()
+        busy = 0.0
+        cur_s, cur_e = evs[0][0], evs[0][1]
+        by_name: dict = {}
+        for s, e, name in evs:
+            by_name[name] = by_name.get(name, 0.0) + (e - s)
+            if s > cur_e:
+                busy += cur_e - cur_s
+                cur_s, cur_e = s, e
+            else:
+                cur_e = max(cur_e, e)
+        busy += cur_e - cur_s
+        pname = proc_names.get(pid, "")
+        tname = thread_names.get((pid, tid), "")
+        label = f"{pname}/{tname}".strip("/")
+        tracks.append({
+            "track": label or f"pid{pid}/tid{tid}",
+            "busy_us": round(busy, 1),
+            "span_us": round(evs[-1][1] - evs[0][0], 1),
+            "n_slices": len(evs),
+            "top": sorted(by_name.items(), key=lambda kv: -kv[1])[:4],
+        })
+    tracks.sort(key=lambda t: -t["busy_us"])
+
+    def _is_device(t: dict) -> bool:
+        lbl = t["track"].lower()
+        return any(k in lbl for k in ("tpu", "device", "xla:#global", "/device:"))
+
+    dev = [t for t in tracks if _is_device(t)]  # already busiest-first
+    return {
+        "tracks": tracks[:12],
+        "device_tracks": len(dev),
+        "device_track": dev[0]["track"] if dev else None,
+        "device_busy_us": dev[0]["busy_us"] if dev else 0.0,
+        "device_span_us": dev[0]["span_us"] if dev else 0.0,
+    }

@@ -12,6 +12,10 @@
 cd /root/repo
 WATCH_T0=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 export WATCH_T0
+# Per-item watchdog floors for the known-slow items (pallas_autotune,
+# ltl_bosco) live in tpu_worklist.py's _ITEM_WATCHDOG_S — do NOT export a
+# big global WORKLIST_WATCHDOG_S here: it would stretch wedge detection
+# on every fast item from 10 to 25 minutes.
 ITEMS=pallas_identity,pallas_autotune,pallas_band,pallas_generations,bench_packed,ltl_bosco,ltl_lowering,ltl_pallas,generations_brain,profile_trace,sparse_tiled,elementary,config5_sparse
 export ITEMS
 trap 'rm -f "${PROBE_OUT:-}"' EXIT

@@ -41,6 +41,17 @@ for _p in (_REPO, os.path.dirname(os.path.abspath(__file__))):
 
 OUT_PATH = os.path.join(_REPO, "results", "tpu_worklist.json")
 WATCHDOG_S = float(os.environ.get("WORKLIST_WATCHDOG_S", "600"))
+# Two items legitimately outrun the default watchdog on the tunnel (the
+# autotune sweep's many compiles; ltl_bosco's dense + bit-sliced rate
+# pairs) — observed 2026-07-31. Raising the GLOBAL watchdog instead would
+# stretch wedge detection on the other 11 items from 10 to 25 minutes
+# each, burning most of a healthy window on one wedge-everywhere cycle.
+_ITEM_WATCHDOG_S = {"pallas_autotune": 1500.0, "ltl_bosco": 1500.0}
+
+
+def _watchdog_for(item: str) -> float:
+    """Per-item watchdog: the item floor or the (env-raisable) global."""
+    return max(WATCHDOG_S, _ITEM_WATCHDOG_S.get(item, 0.0))
 # WORKLIST_SMOKE=1 shrinks the rate sections of the newer children so a
 # CPU run can validate their logic in seconds (tests use this); the
 # identity sections always run full.
@@ -409,12 +420,15 @@ def child_pallas_generations() -> dict:
 
 
 def child_profile_trace() -> dict:
-    """A real profiler trace of the Pallas kernel (utils/profiling.py):
-    records that the trace capture machinery works against the actual
-    chip and how much device activity one 64-generation dispatch logs —
-    the measured counterpart of Engine.halo_bytes_per_gen-style estimates."""
+    """A real profiler trace of the Pallas kernel (utils/profiling.py),
+    captured as a perfetto trace into ``results/trace/`` and summarized
+    into measured numbers (VERDICT round-2 item #6: replace the
+    arithmetic roofline with a measured one): interval-union busy time
+    per device track gives the kernel's measured duty cycle and the
+    measured in-kernel cell-update rate for the 64-generation dispatch."""
     import glob
     import os
+    import shutil
     import tempfile
 
     import jax
@@ -427,26 +441,67 @@ def child_profile_trace() -> dict:
         multi_step_pallas,
     )
     from gameoflifewithactors_tpu.ops.stencil import Topology
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
 
     interp = default_interpret()  # native on TPU; CPU smoke uses interpret
     rng = np.random.default_rng(2)
-    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(4096, 512), dtype=np.uint32))
+    rows, words, gens = (256, 8, 8) if _SMOKE else (4096, 512, 64)
+    p = jnp.asarray(rng.integers(0, 2 ** 32, size=(rows, words), dtype=np.uint32))
     p = multi_step_pallas(p, 8, rule=CONWAY, topology=Topology.TORUS,
                           interpret=interp)  # warm
     _sync_scalar(p)
-    with tempfile.TemporaryDirectory() as d:
-        with jax.profiler.trace(d):
-            p = multi_step_pallas(p, 64, rule=CONWAY, topology=Topology.TORUS,
-                                  interpret=interp)
-            _sync_scalar(p)
-        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
-        sizes = {os.path.basename(f): os.path.getsize(f)
-                 for f in files if os.path.isfile(f)}
-    total = sum(sizes.values())
-    return {"ok": total > 0, "trace_bytes": total,
-            "n_files": len(sizes),
-            "largest": sorted(sizes.items(), key=lambda kv: -kv[1])[:3],
-            "platform": jax.devices()[0].platform}
+    final_dir = os.path.join(_REPO, "results", "trace")
+    if _SMOKE:
+        # validation run: must not clobber a real captured chip trace
+        out_dir = tempfile.mkdtemp(prefix="trace_smoke_")
+    else:
+        # capture into a sibling dir and swap AFTER the capture succeeds:
+        # a wedge mid-capture (watchdog kill) must not have already
+        # deleted the previous window's good trace
+        out_dir = final_dir + ".new"
+        shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir, create_perfetto_trace=True)
+    try:
+        p = multi_step_pallas(p, gens, rule=CONWAY, topology=Topology.TORUS,
+                              interpret=interp)
+        _sync_scalar(p)
+    finally:
+        jax.profiler.stop_trace()
+    if not _SMOKE and any(
+            os.path.isfile(f) for f in
+            glob.glob(os.path.join(out_dir, "**", "*"), recursive=True)):
+        shutil.rmtree(final_dir, ignore_errors=True)
+        os.replace(out_dir, final_dir)
+        out_dir = final_dir
+    files = [f for f in glob.glob(os.path.join(out_dir, "**", "*"),
+                                  recursive=True) if os.path.isfile(f)]
+    sizes = {os.path.relpath(f, out_dir): os.path.getsize(f) for f in files}
+    perfetto = [f for f in files if f.endswith("perfetto_trace.json.gz")]
+    result: dict = {
+        "ok": sum(sizes.values()) > 0,
+        "trace_bytes": sum(sizes.values()),
+        "n_files": len(sizes),
+        "largest": sorted(sizes.items(), key=lambda kv: -kv[1])[:3],
+        "platform": jax.devices()[0].platform,
+        "dispatch": {"rows": rows, "words": words, "gens": gens,
+                     "cell_updates": rows * words * 32 * gens},
+    }
+    if perfetto:
+        summ = perfetto_summary(perfetto[0])
+        result["perfetto"] = summ
+        busy_s = summ["device_busy_us"] / 1e6
+        if summ["device_tracks"] and busy_s > 0:
+            # measured, not arithmetic: cell-updates over the busiest
+            # device track's interval-union busy seconds
+            result["measured_in_kernel_rate"] = (
+                rows * words * 32 * gens / busy_s)
+            result["measured_duty_cycle"] = (
+                summ["device_busy_us"] / summ["device_span_us"]
+                if summ["device_span_us"] else None)
+    if _SMOKE:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return result
 
 
 def child_ltl_pallas() -> dict:
@@ -774,18 +829,20 @@ def main() -> int:
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), "--item", item],
-                    capture_output=True, text=True, timeout=WATCHDOG_S)
+                    capture_output=True, text=True, timeout=_watchdog_for(item))
                 line = next((ln for ln in reversed(r.stdout.strip().splitlines())
                              if ln.startswith("{")), None)
                 result = (json.loads(line) if r.returncode == 0 and line
                           else {"ok": False, "detail": (r.stderr or r.stdout)[-800:]})
             except subprocess.TimeoutExpired:
-                result = {"ok": False, "detail": f"hung >{WATCHDOG_S}s (wedged?)"}
+                result = {"ok": False,
+                          "detail": f"hung >{_watchdog_for(item)}s (wedged?)"}
         else:
             try:
                 result = ITEMS[item]()
             except subprocess.TimeoutExpired:
-                result = {"ok": False, "detail": f"hung >{WATCHDOG_S}s (wedged?)"}
+                result = {"ok": False,
+                          "detail": f"hung >{_watchdog_for(item)}s (wedged?)"}
         result["elapsed_s"] = round(time.time() - t0, 1)
         _merge(item, result)
         print(f"{item}: {'ok' if result.get('ok') else 'FAILED'} "

@@ -140,7 +140,7 @@ def test_worklist_children_smoke_cpu():
     # (the same reason bench.py strips it for its CPU fallback child)
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "WORKLIST_SMOKE": "1",
            "PYTHONPATH": axon_guard.strip_pythonpath()}
-    for item in ("sparse_tiled", "elementary"):
+    for item in ("sparse_tiled", "elementary", "profile_trace"):
         r = subprocess.run(
             [sys.executable, "scripts/tpu_worklist.py", "--item", item],
             capture_output=True, text=True, timeout=420, env=env,
@@ -150,8 +150,14 @@ def test_worklist_children_smoke_cpu():
         assert r.returncode == 0 and line, (item, r.stderr[-600:])
         d = json.loads(line)
         assert d.get("ok") is True, (item, d)
-        assert all(c.get("bit_identical", c.get("oracle_match"))
-                   for c in d["cases"]), (item, d["cases"])
+        if item == "profile_trace":
+            # perfetto capture + parse ran; CPU has no device tracks, but
+            # the host python track must have recorded real slices
+            assert d["trace_bytes"] > 0 and "perfetto" in d, d
+            assert d["perfetto"]["tracks"], d["perfetto"]
+        else:
+            assert all(c.get("bit_identical", c.get("oracle_match"))
+                       for c in d["cases"]), (item, d["cases"])
 
 
 def test_weak_scaling_script_end_to_end():
