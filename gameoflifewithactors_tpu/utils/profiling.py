@@ -222,9 +222,12 @@ def perfetto_summary(trace_path: str) -> dict:
         evs.sort()
         busy = 0.0
         cur_s, cur_e = evs[0][0], evs[0][1]
+        max_end = evs[0][1]  # sort is by start: a nested slice sorts last
+        # but can end before its parent, so the span needs the max end
         by_name: dict = {}
         for s, e, name in evs:
             by_name[name] = by_name.get(name, 0.0) + (e - s)
+            max_end = max(max_end, e)
             if s > cur_e:
                 busy += cur_e - cur_s
                 cur_s, cur_e = s, e
@@ -237,7 +240,7 @@ def perfetto_summary(trace_path: str) -> dict:
         tracks.append({
             "track": label or f"pid{pid}/tid{tid}",
             "busy_us": round(busy, 1),
-            "span_us": round(evs[-1][1] - evs[0][0], 1),
+            "span_us": round(max_end - evs[0][0], 1),
             "n_slices": len(evs),
             "top": sorted(by_name.items(), key=lambda kv: -kv[1])[:4],
         })

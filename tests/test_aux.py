@@ -33,6 +33,49 @@ def test_profile_steps_writes_trace(tmp_path):
     assert walked, "no profiler output written"
 
 
+def test_perfetto_summary_busiest_track_semantics(tmp_path):
+    """The measured-roofline parser: interval-union busy time (nested and
+    overlapping slices must not double count), and device numbers taken
+    from the single busiest device track — a TPU dump mirrors one device
+    across several track layers, so summing them would let the duty cycle
+    exceed 1.0."""
+    import json
+
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "host:CPU"}},
+        {"ph": "M", "pid": 2, "tid": 9, "name": "thread_name",
+         "args": {"name": "python"}},
+        # device layer 1: one 100us module slice with a nested 60us slice
+        # -> union busy 100, not 160
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 100, "name": "jit_step"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 20, "dur": 60, "name": "fusion"},
+        # device layer 2 mirrors the same wall time as separate ops
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 50, "name": "op_a"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 50, "dur": 40, "name": "op_b"},
+        # host track, busier than the device in wall time
+        {"ph": "X", "pid": 2, "tid": 9, "ts": 0, "dur": 500, "name": "dispatch"},
+    ]
+    path = tmp_path / "perfetto_trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    s = perfetto_summary(str(path))
+    assert s["device_tracks"] == 2
+    # busiest DEVICE track wins (not the busier host track), union not sum
+    assert s["device_track"] == "device:TPU:0/XLA Modules"
+    assert s["device_busy_us"] == 100.0
+    assert s["device_busy_us"] <= s["device_span_us"]
+    host = [t for t in s["tracks"] if t["track"] == "host:CPU/python"]
+    assert host and host[0]["busy_us"] == 500.0
+
+
 def test_fault_injectors_change_state():
     g = seeds.seeded((16, 32), "glider", 2, 2)
     e = Engine(g, "conway")
