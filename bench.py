@@ -77,6 +77,10 @@ def _parse(argv):
     ap.add_argument("--rule", default="B3/S23")
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the tunnel-health preflight (go straight to the watchdog)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the provenance scoreboard of every persisted "
+                         "record (bench + worklist) and exit; needs no TPU "
+                         "and never imports jax")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -144,6 +148,49 @@ def _persist_if_best(key: str, result: dict) -> None:
             json.dump(store, f, indent=1)
             f.write("\n")
         os.replace(tmp, PERSIST_PATH)
+
+
+def report() -> None:
+    """Provenance scoreboard: every persisted record in results/ with its
+    commit stamp and current staleness — one glance answers "which numbers
+    describe the code at HEAD and which describe a predecessor". Stdlib
+    only (safe while the tunnel is wedged)."""
+    prov = _provenance()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rows = []
+    for path, label in ((PERSIST_PATH, "bench"),
+                        (os.path.join(repo, "results", "tpu_worklist.json"),
+                         "worklist")):
+        try:
+            with open(path) as f:
+                store = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for key, rec in sorted(store.items()):
+            if not isinstance(rec, dict):
+                continue
+            st = prov.staleness(rec)
+            rows.append({
+                "source": label, "key": key,
+                "ok": rec.get("ok"),
+                "value": rec.get("value"),
+                "unit": rec.get("unit"),
+                "commit": rec.get("commit"),
+                "recorded_at": rec.get("recorded_at"),
+                "stale": st["stale"],
+                "changed_paths": st.get("changed", [])[:4],
+            })
+    head = prov.git_head()
+    fresh = sum(1 for r in rows if r["ok"] and not r["stale"])
+    for r in rows:
+        flag = ("FRESH" if r["ok"] and not r["stale"]
+                else "stale" if r["ok"] else "FAILED")
+        val = (f"{r['value']:.3g} {r['unit'] or ''}".strip()
+               if isinstance(r["value"], (int, float)) else "-")
+        print(f"{flag:6} {r['source']:8} {r['key']:28} {val:26} "
+              f"@{r['commit'] or '?'} {r['recorded_at'] or '?'}")
+    print(json.dumps({"report": True, "head": head, "records": len(rows),
+                      "fresh_ok": fresh}))
 
 
 def run_bench(args) -> None:
@@ -375,6 +422,9 @@ def run_bench(args) -> None:
 
 def main() -> None:
     args = _parse(sys.argv[1:])
+    if args.report:
+        report()
+        return
     if args.child:
         run_bench(args)
         return

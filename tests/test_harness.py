@@ -124,6 +124,28 @@ def test_bench_config_key_uses_requested_size():
     assert bench._config_key(a) != bench._config_key(b)
 
 
+def test_bench_report_scoreboard():
+    """`bench.py --report` prints the provenance scoreboard without
+    importing jax (must work while the tunnel is wedged) and ends with a
+    machine-readable JSON summary line."""
+    import json
+    import sys
+
+    import axon_guard
+
+    env = {**os.environ, "PYTHONPATH": axon_guard.strip_pythonpath()}
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                        "--report"],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-600:]
+    last = r.stdout.strip().splitlines()[-1]
+    d = json.loads(last)
+    assert d["report"] is True and d["records"] >= 1
+    # the committed store always has the headline auto record
+    assert any(ln.split()[2] == "auto:default:B3/S23"
+               for ln in r.stdout.splitlines() if ln.startswith(("FRESH", "stale")))
+
+
 def test_worklist_children_smoke_cpu():
     """The round-3 worklist children (sparse_tiled, elementary) validated
     end-to-end on CPU at WORKLIST_SMOKE=1 scale — a regression (bad
