@@ -178,7 +178,7 @@ def report() -> None:
                 "commit": rec.get("commit"),
                 "recorded_at": rec.get("recorded_at"),
                 "stale": st["stale"],
-                "changed_paths": st.get("changed", [])[:4],
+                "reason": st["reason"],
             })
     head = prov.git_head()
     fresh = sum(1 for r in rows if r["ok"] and not r["stale"])
@@ -187,8 +187,9 @@ def report() -> None:
                 else "stale" if r["ok"] else "FAILED")
         val = (f"{r['value']:.3g} {r['unit'] or ''}".strip()
                if isinstance(r["value"], (int, float)) else "-")
+        why = f"  [{r['reason']}]" if r["stale"] else ""
         print(f"{flag:6} {r['source']:8} {r['key']:28} {val:26} "
-              f"@{r['commit'] or '?'} {r['recorded_at'] or '?'}")
+              f"@{r['commit'] or '?'} {r['recorded_at'] or '?'}{why}")
     print(json.dumps({"report": True, "head": head, "records": len(rows),
                       "fresh_ok": fresh}))
 

@@ -79,8 +79,13 @@ def _device_equal(a, b) -> bool:
 
 
 def child_bench_packed() -> dict:
+    # --backend packed explicitly: the default (auto) resolves to pallas on
+    # TPU, which silently replaced the only packed-SWAR evidence in round 3
+    # (ADVICE r3). The pallas number already lives in tpu_best.json's
+    # auto:default record; this item owns the packed path.
     r = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "bench.py"), "--no-probe"],
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--no-probe",
+         "--backend", "packed"],
         capture_output=True, text=True, timeout=WATCHDOG_S)
     line = next((ln for ln in reversed(r.stdout.strip().splitlines())
                  if ln.startswith("{")), None)
@@ -93,6 +98,11 @@ def child_bench_packed() -> dict:
         # as captured and exit without real TPU evidence
         return {**result, "ok": False,  # ok LAST: result carries ok:true
                 "detail": "bench served a persisted record; no fresh TPU measurement"}
+    if "cpu" in result.get("metric", ""):
+        # bench's CPU fallback: a host number must not stand in for the
+        # packed TPU north-star (the watcher would count it captured)
+        return {**result, "ok": False,
+                "detail": "bench fell back to CPU; no TPU measurement"}
     return {"ok": True, **result}
 
 
@@ -468,12 +478,25 @@ def child_profile_trace() -> dict:
         _sync_scalar(p)
     finally:
         jax.profiler.stop_trace()
-    if not _SMOKE and any(
-            os.path.isfile(f) for f in
-            glob.glob(os.path.join(out_dir, "**", "*"), recursive=True)):
-        shutil.rmtree(final_dir, ignore_errors=True)
-        os.replace(out_dir, final_dir)
-        out_dir = final_dir
+    if not _SMOKE:
+        if any(os.path.isfile(f) for f in
+               glob.glob(os.path.join(out_dir, "**", "*"), recursive=True)):
+            # move the old dir ASIDE (atomic) rather than rmtree-ing it in
+            # place: a partial rmtree under ignore_errors would make the
+            # following replace raise AFTER a successful capture (ADVICE r3).
+            # The aside name is unique per run — a fixed ".old" could be
+            # left non-empty by a killed predecessor and collide here.
+            old_dir = tempfile.mkdtemp(prefix="trace_old_",
+                                       dir=os.path.dirname(final_dir))
+            if os.path.isdir(final_dir):
+                os.replace(final_dir, os.path.join(old_dir, "trace"))
+            os.replace(out_dir, final_dir)
+            shutil.rmtree(old_dir, ignore_errors=True)
+            out_dir = final_dir
+        else:
+            # empty capture: don't leave a stale trace.new behind (the
+            # globs below return [] for the removed dir -> ok: False)
+            shutil.rmtree(out_dir, ignore_errors=True)
     files = [f for f in glob.glob(os.path.join(out_dir, "**", "*"),
                                   recursive=True) if os.path.isfile(f)]
     sizes = {os.path.relpath(f, out_dir): os.path.getsize(f) for f in files}
