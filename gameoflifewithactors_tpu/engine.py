@@ -62,13 +62,15 @@ class Engine:
     mesh: optional jax Mesh for 2D sharding; None = single device.
     backend: "auto" (default: the fastest correct path — on TPU that is
         the "pallas" kernel for 3x3 binary rules single-device and on
-        (nx, 1) row-band meshes at supported shapes, either topology,
-        "packed" otherwise), "packed" (32 cells/word SWAR fast path),
-        "dense" (1 byte/cell, debug path), "pallas" (temporal-blocked
-        Mosaic kernel advancing several generations per HBM round-trip;
-        serves 3x3 binary rules and Generations rules, single-device and
-        on (nx, 1) meshes — DEAD vertical closure rides a per-device SMEM
-        edge code), or "sparse" (activity-tiled: compute
+        any mesh whose flattened band decomposition the kernel supports
+        (2D meshes flatten into nx·ny full-width row bands), either
+        topology, "packed" otherwise), "packed" (32 cells/word SWAR fast
+        path), "dense" (1 byte/cell, debug path), "pallas"
+        (temporal-blocked Mosaic kernel advancing several generations per
+        HBM round-trip; serves 3x3 binary rules, Generations, and LtL
+        rules, single-device and on meshes via flattened row bands — DEAD
+        vertical closure rides a per-device SMEM edge code), or "sparse"
+        (activity-tiled: compute
         scales with changed area, for huge mostly-empty universes;
         3x3 binary bitboards and, single-device, Generations bit-plane
         stacks; both topologies on one device — torus refreshes the halo
@@ -147,7 +149,13 @@ class Engine:
         # it shares all the _packed machinery (snapshot/population/
         # checkpoint); sharded tiles exchange r-row + 1-word halos
         _ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
-        _packs = self.shape[1] % (bitpack.WORD * _ny) == 0  # words shard whole
+        # the band-kernel runners flatten the mesh into full-width row
+        # bands (parallel/sharded.py), so the pallas path never shards the
+        # width: packing only needs whole 32-cell words
+        _band_path = mesh is not None and backend == "pallas"
+        self._banded = False  # finalized in the mesh block below
+        _pack_cols = 1 if _band_path else _ny
+        _packs = self.shape[1] % (bitpack.WORD * _pack_cols) == 0  # words shard whole
         # sparse LtL rides the same bit-sliced packed windows and the
         # pallas LtL kernel the same packed layout, so all three share the
         # packed gate (word-divisible width and binary states; both
@@ -233,14 +241,31 @@ class Engine:
             # user's grid shape, not the packed word shape
             nx = mesh.shape[mesh_lib.ROW_AXIS]
             ny = mesh.shape[mesh_lib.COL_AXIS]
-            wq = (bitpack.WORD * ny if self._packed or self._gen_packed
-                  else ny)
-            if self.shape[0] % nx or self.shape[1] % wq:
-                raise ValueError(
-                    f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
-                    f"need height % {nx} == 0 and width % {wq} == 0"
-                    + (" (bit-packed backends shard 32-cell words)" if self._packed else "")
-                )
+            # the dense fallbacks above may have walked an explicit pallas
+            # request off the band path — re-derive from the final backend.
+            # On (nx, 1) meshes the flattened spec degenerates to the
+            # proven P('x', None) layout, so _banded placement only kicks
+            # in when the column axis is real.
+            _band_path = backend == "pallas"
+            self._banded = _band_path and ny > 1
+            if _band_path:
+                # band path: nx*ny full-width bands over the flattened
+                # mesh; the width packs whole words but is not sharded
+                if self.shape[0] % (nx * ny) or self.shape[1] % bitpack.WORD:
+                    raise ValueError(
+                        f"grid {self.shape} not divisible into {nx * ny} "
+                        f"full-width row bands over mesh ({nx}, {ny}): need "
+                        f"height % {nx * ny} == 0 and width % "
+                        f"{bitpack.WORD} == 0 (band-kernel path)")
+            else:
+                wq = (bitpack.WORD * ny if self._packed or self._gen_packed
+                      else ny)
+                if self.shape[0] % nx or self.shape[1] % wq:
+                    raise ValueError(
+                        f"grid {self.shape} not divisible over mesh ({nx}, {ny}): "
+                        f"need height % {nx} == 0 and width % {wq} == 0"
+                        + (" (bit-packed backends shard 32-cell words)" if self._packed else "")
+                    )
         if self._gen_packed:
             from .ops.packed_generations import pack_generations_for
 
@@ -248,20 +273,28 @@ class Engine:
         else:
             state = bitpack.pack(grid) if self._packed else grid
         if mesh is not None:
-            state = mesh_lib.device_put_sharded_grid(state, mesh)
+            state = mesh_lib.device_put_sharded_grid(state, mesh,
+                                                     banded=self._banded)
             def _band_kernel(make_band, make_pergen):
                 # row-band native kernel: bulk chunks of g generations
                 # through the slab kernel, n % g remainders on the
                 # per-generation runner — one definition for the binary,
-                # Generations, and LtL twins
+                # Generations, and LtL twins. On 2D meshes the remainder
+                # runner must keep the flattened band layout (and its
+                # width-not-sharded contract), so it is the banded XLA
+                # runner, not the 2D-tile one.
                 g = (gens_per_exchange if gens_per_exchange > 1
                      else pallas_stencil.DEFAULT_GENS_PER_CALL)
                 self.gens_per_exchange = g
+                pergen = (
+                    sharded.make_multi_step_banded(
+                        mesh, self.rule, topology, donate=True)
+                    if ny > 1
+                    else make_pergen(mesh, self.rule, topology, donate=True))
                 return _chunked(
                     make_band(mesh, self.rule, topology,
                               gens_per_exchange=g, donate=True),
-                    make_pergen(mesh, self.rule, topology, donate=True),
-                    g)
+                    pergen, g)
 
             def _tiled_sparse(make):
                 # shared tile-dim resolution for the per-tile sharded
@@ -290,7 +323,19 @@ class Engine:
                     mesh, tr, tw, state)
             if self._ltl:
                 r = self.rule.radius
-                if self.shape[0] // nx < r or self.shape[1] // ny < r:
+                if _band_path:
+                    # band path: full-width bands of h/(nx*ny) rows — the
+                    # width is never sharded, so only the band height
+                    # gates (>= r for the per-gen remainder exchange; the
+                    # kernel's deeper r*g chunk requirement raises its own
+                    # trace-time error naming gens_per_exchange)
+                    if self.shape[0] // (nx * ny) < r:
+                        raise ValueError(
+                            f"{self.shape[0] // (nx * ny)}-row bands over "
+                            f"the flattened ({nx}, {ny}) mesh are smaller "
+                            f"than the rule radius {r}: halo exchange "
+                            "needs depth <= band height; use fewer devices")
+                elif self.shape[0] // nx < r or self.shape[1] // ny < r:
                     raise ValueError(
                         f"mesh tiles {self.shape[0] // nx}x{self.shape[1] // ny} "
                         f"smaller than the rule radius {r}: halo exchange "
@@ -507,10 +552,11 @@ class Engine:
                       gens_per_exchange: int = 1) -> str:
         """'auto' = the fastest correct backend for this rule/platform/shape:
         the temporal-blocked native Pallas kernel (canonical-protocol
-        1.33e12 cell-updates/s on a v5e, ~7.6x the XLA SWAR rate) for 3x3
-        binary rules at shapes it supports — single-device, and (nx, 1)
-        row-band meshes on TPU, either topology; the packed SWAR path
-        everywhere else. Off
+        2.2e12 cell-updates/s on a v5e, ~12x the XLA SWAR rate) for 3x3
+        binary rules at shapes it supports — single-device, and any mesh
+        whose flattened row-band decomposition the kernel takes (2D
+        meshes flatten, parallel/sharded.py) on TPU, either topology; the
+        packed SWAR path everywhere else. Off
         'packed', Generations rules take the bit-plane stack when the width
         packs (% 32), the byte path otherwise; LtL picks bit-sliced packed
         on TPU and the byte path elsewhere (see the platform note below)."""
@@ -537,20 +583,21 @@ class Engine:
         if len(shape) != 2 or shape[1] % bitpack.WORD:
             return "packed"  # shape errors surface in the main path
         if mesh is not None:
-            # native row-band path: (nx, 1) meshes whose bands keep the
-            # kernel's alignment (width % 4096, extended band height
-            # divisible into 8-row blocks: th % 8, exchange depth % 8);
+            # native row-band path: any mesh whose FLATTENED band
+            # decomposition (nx·ny full-width bands — 2D meshes flatten,
+            # parallel/sharded.py _band_axis) keeps the kernel's alignment
+            # (width % 4096, band height th % 8, exchange depth % 8);
             # both topologies (DEAD rides the kernel's SMEM edge code).
             # An explicit gens_per_exchange the slab kernel cannot honor
             # (not a multiple of 8, or deeper than the band) must keep
             # resolving to the packed deep runner, as it did before the
             # band path existed — auto never picks a crashing backend.
-            nx = mesh.shape[mesh_lib.ROW_AXIS]
-            ny = mesh.shape[mesh_lib.COL_AXIS]
-            th = shape[0] // nx if shape[0] % nx == 0 else 0
+            nb = (mesh.shape[mesh_lib.ROW_AXIS]
+                  * mesh.shape[mesh_lib.COL_AXIS])
+            th = shape[0] // nb if shape[0] % nb == 0 else 0
             g = (gens_per_exchange if gens_per_exchange > 1
                  else pallas_stencil.DEFAULT_GENS_PER_CALL)
-            if (on_tpu and ny == 1 and th > 0
+            if (on_tpu and th > 0
                     and pallas_stencil.band_supported(
                         th, g, native=True,
                         wp=shape[1] // bitpack.WORD)
@@ -632,6 +679,27 @@ class Engine:
         itemsize = 4 if self._packed else 1
         depth = self.rule.radius if self._ltl else 1  # strip depth in rows/cols
         g = self.gens_per_exchange
+        wrap = self.topology is Topology.TORUS
+        if self.backend == "pallas":
+            # band-kernel path: the mesh flattens into nb full-width row
+            # bands; per chunk each band ppermutes depth-(r·g) row strips
+            # of the full packed width (× b planes stacked for
+            # Generations), no column phase — then amortized over the g
+            # generations the chunk advances. On (nx, 1) meshes this is
+            # identical to the per-family branches below with their
+            # column sends zeroed; on 2D meshes it is the only correct
+            # model (the width is not sharded).
+            nb = nx * ny
+            if nb == 1:
+                return 0
+            b = 1
+            if self._gen_packed:
+                from .ops.packed_generations import n_planes
+
+                b = n_planes(self.rule.states)
+            strip = b * depth * g * (w // bitpack.WORD) * 4
+            sends = 2 * (nb if wrap else nb - 1)
+            return -(-sends * strip // g)  # ceil: per-generation figure
         if self._ltl_packed:
             # r halo rows of packed words + ONE halo word per side
             # (32 >= r cells), on a (h + 2r)-row-extended tile; the band
@@ -661,7 +729,6 @@ class Engine:
             row_strip = depth * (wq // ny) * itemsize  # d rows of one tile
             # d columns of a row-extended (h + 2d rows) tile
             col_strip = depth * (h // nx + 2 * depth) * itemsize
-        wrap = self.topology is Topology.TORUS
         # a size-1 axis exchanges nothing over the interconnect (the torus
         # "send" is a device-local self-copy); DEAD edges drop the wrap send
         row_sends = 2 * ny * (nx if wrap else nx - 1) if nx > 1 else 0
@@ -725,7 +792,8 @@ class Engine:
         else:
             state = bitpack.pack(grid) if self._packed else grid
         if self.mesh is not None:
-            state = mesh_lib.device_put_sharded_grid(state, self.mesh)
+            state = mesh_lib.device_put_sharded_grid(state, self.mesh,
+                                                     banded=self._banded)
         if self._sparse is not None:
             self._sparse = self._sparse.reseed(state)
         else:

@@ -43,22 +43,26 @@ def _shift_perm(n: int, direction: int, wrap: bool) -> List[Tuple[int, int]]:
     return perm
 
 
-def band_edge_code(nx: int, axis: str = ROW_AXIS) -> jax.Array:
+def band_edge_code(nx: int, axis=ROW_AXIS) -> jax.Array:
     """This device's global-edge code for row-band decompositions, as the
     (1, 1) int32 SMEM operand the dead_band slab kernels consume
     (ops/pallas_stencil.py _zero_band_exterior): bit0 = the device holds
     the global top band, bit1 = the bottom. One definition for every band
-    runner so the bit contract can't drift between them. shard_map only."""
+    runner so the bit contract can't drift between them. shard_map only.
+    ``axis`` may be a tuple of mesh axis names — the flattened band axis
+    of the 2D-mesh band runners (``lax.axis_index`` composes row-major)."""
     ix = lax.axis_index(axis)
     return (jnp.where(ix == 0, 1, 0)
             | jnp.where(ix == nx - 1, 2, 0)).astype(jnp.int32).reshape(1, 1)
 
 
-def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis: str = ROW_AXIS,
+def exchange_rows(tile: jax.Array, nx: int, topology: Topology, axis=ROW_AXIS,
                   depth: int = 1) -> jax.Array:
     """(h, w) tile -> (h+2·depth, w) with north/south halo strips of
     ``depth`` rows from mesh neighbors (depth > 1 serves radius-r stencils
-    like Larger-than-Life; requires depth <= tile height)."""
+    like Larger-than-Life; requires depth <= tile height). ``axis`` may be
+    a tuple of mesh axis names treated as one flattened axis of size ``nx``
+    (the 2D-mesh band runners' x-major band ordering)."""
     wrap = topology is Topology.TORUS
     # My north halo rows are my north neighbor's bottom rows: data flows +1.
     north = lax.ppermute(tile[-depth:], axis, _shift_perm(nx, +1, wrap))
@@ -77,14 +81,15 @@ def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_A
 
 
 def exchange_rows_stack(stack: jax.Array, nx: int, topology: Topology,
-                        depth: int = 1) -> jax.Array:
+                        axis=ROW_AXIS, depth: int = 1) -> jax.Array:
     """(b, h, w) stack -> (b, h+2d, w): the row half of
     :func:`exchange_halo_stack` — one ppermute per side carries all b
     members. Serves the batched row-band runner, whose full-width bands
-    need no column phase."""
+    need no column phase. ``axis`` may be a flattened axis-name tuple,
+    like :func:`exchange_rows`."""
     wrap = topology is Topology.TORUS
-    north = lax.ppermute(stack[:, -depth:, :], ROW_AXIS, _shift_perm(nx, +1, wrap))
-    south = lax.ppermute(stack[:, :depth, :], ROW_AXIS, _shift_perm(nx, -1, wrap))
+    north = lax.ppermute(stack[:, -depth:, :], axis, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(stack[:, :depth, :], axis, _shift_perm(nx, -1, wrap))
     return jnp.concatenate([north, stack, south], axis=1)
 
 

@@ -158,12 +158,25 @@ def check_divisible(shape: Tuple[int, int], mesh: Mesh) -> None:
         )
 
 
-def device_put_sharded_grid(grid: jax.Array, mesh: Mesh) -> jax.Array:
+def device_put_sharded_grid(grid: jax.Array, mesh: Mesh,
+                            banded: bool = False) -> jax.Array:
     """Place a grid onto the mesh with 2D spatial tiling.
 
     Accepts (H, W) / (H, W/32) grids, or a (b, H, W/32) bit-plane stack
     (Generations packed layout) whose leading plane axis is replicated.
+    ``banded=True`` places full-width row bands over the FLATTENED mesh
+    instead (``P(('x', 'y'), None)``) — the layout the band-kernel runners
+    use on 2D meshes (parallel/sharded.py); rows must divide by nx·ny.
     """
+    if banded:
+        nb = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+        if grid.shape[-2] % nb:
+            raise ValueError(
+                f"grid rows {grid.shape[-2]} not divisible into {nb} "
+                f"full-width bands over the flattened mesh")
+        spec = (P(None, (ROW_AXIS, COL_AXIS), None) if grid.ndim == 3
+                else P((ROW_AXIS, COL_AXIS), None))
+        return jax.device_put(grid, NamedSharding(mesh, spec))
     if grid.ndim == 3:
         check_divisible(grid.shape[1:], mesh)
         return jax.device_put(

@@ -134,7 +134,16 @@ def measured_halo_bytes_per_gen(engine) -> int:
 
     if engine.mesh is None:
         return 0
-    if getattr(engine, "_ltl_packed", False):
+    if engine.backend == "pallas":
+        # band engines amortize the depth-(r·g) chunk exchange over its g
+        # generations, which lands exactly on the banded per-generation
+        # runner's rate (r rows/gen, full width, × b planes) — lower THAT
+        # for the per-generation measured figure; the chunk itself is a
+        # pallas kernel whose exchange XLA cannot lower on CPU
+        step1 = sharded.make_multi_step_banded(
+            engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_ltl_packed", False):
         step1 = sharded.make_multi_step_ltl_packed(
             engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)

@@ -111,9 +111,19 @@ def test_auto_resolution_tpu_branches(monkeypatch):
     for topo in (Topology.TORUS, Topology.DEAD):
         assert host._resolve_auto(np.zeros((4096, 4096), np.uint8), m,
                                   topo) == "pallas"
-    # 2D tile mesh cannot band -> packed
-    m22 = mesh_lib.make_mesh((2, 4))
-    assert host._resolve_auto(np.zeros((4096, 4096), np.uint8), m22,
+    # 2D meshes flatten into nx*ny full-width bands -> pallas too
+    # (VERDICT r3 Missing #4)
+    m24 = mesh_lib.make_mesh((2, 4))
+    assert host._resolve_auto(np.zeros((4096, 4096), np.uint8), m24,
+                              Topology.TORUS) == "pallas"
+    # ...but only when the flattened decomposition exists: height not
+    # divisible into nx*ny bands -> packed
+    assert host._resolve_auto(np.zeros((4100, 4096), np.uint8), m24,
+                              Topology.TORUS) == "packed"
+    # bands shorter than the exchange depth (4096/8 devices = 512-row
+    # grid -> 64-row bands is fine; 64-row grid -> 8-row bands == g) still
+    # band; a 32-row grid (4-row bands < g=8) cannot
+    assert host._resolve_auto(np.zeros((32, 4096), np.uint8), m24,
                               Topology.TORUS) == "packed"
 
     # LtL on TPU: bit-sliced packed for binary (both neighborhoods),

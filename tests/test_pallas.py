@@ -244,8 +244,9 @@ class TestLtLKernel:
                                  block_rows=8, gens_per_call=2,
                                  interpret=True)
 
+    @pytest.mark.parametrize("mesh_shape", [(4, 1), (2, 4)])
     @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
-    def test_band_runner_bit_identity(self, topology):
+    def test_band_runner_bit_identity(self, mesh_shape, topology):
         import jax
 
         from gameoflifewithactors_tpu.models.ltl import LtLRule
@@ -254,14 +255,16 @@ class TestLtLKernel:
         from gameoflifewithactors_tpu.parallel import sharded
 
         rule = LtLRule(radius=2, born=(8, 12), survive=(9, 16))
-        m = mesh_lib.make_mesh((4, 1), jax.devices()[:4])
+        n = mesh_shape[0] * mesh_shape[1]
+        m = mesh_lib.make_mesh(mesh_shape, jax.devices()[:n])
         rng = np.random.default_rng(53)
         p = jnp.asarray(rng.integers(0, 2 ** 32, size=(96, 4),
                                      dtype=np.uint32))
         want = multi_step_ltl_packed(p, 6, rule=rule, topology=topology)
         run = sharded.make_multi_step_ltl_pallas(
             m, rule, topology, gens_per_exchange=2, interpret=True)
-        got = run(mesh_lib.device_put_sharded_grid(p, m), 3)
+        got = run(mesh_lib.device_put_sharded_grid(
+            p, m, banded=mesh_shape[1] > 1), 3)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
     def test_engine_facade_band_mesh(self):
@@ -294,6 +297,27 @@ class TestLtLKernel:
         with pytest.raises(ValueError, match="needs the LtL band kernel"):
             Engine(np.zeros((96, 48), np.uint8), "bosco", mesh=m,
                    backend="pallas", gens_per_exchange=2)
+
+    def test_band_guard_validates_band_dims_not_tile_dims(self):
+        """(review finding) the constructor's LtL mesh guard must check
+        BAND dimensions on the pallas path: a narrow full-width grid is
+        fine (the width never shards over the mesh columns), while a band
+        shorter than the radius must be rejected up front."""
+        import jax
+
+        from gameoflifewithactors_tpu import Engine
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+        m = mesh_lib.make_mesh((1, 8), jax.devices())
+        grid = np.zeros((512, 32), np.uint8)   # 64-row bands, 1-word width
+        e = Engine(grid, "R5,C0,M1,S34..58,B34..45", mesh=m,
+                   backend="pallas", gens_per_exchange=8)
+        e.step(8)                              # r*g = 40 <= 64-row bands
+        assert e.population() == 0
+        with pytest.raises(ValueError, match="smaller than the rule radius"):
+            Engine(np.zeros((32, 32), np.uint8),   # 4-row bands < r = 5
+                   "R5,C0,M1,S34..58,B34..45", mesh=m,
+                   backend="pallas", gens_per_exchange=8)
 
     def test_engine_facade_and_fallback(self):
         import warnings as w
