@@ -272,13 +272,15 @@ def run_bench(args) -> None:
             _route_rule(platform == "tpu" and rule.states == 2,
                         "bit-sliced packed")
     elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse"):
-        # LtL: bit-sliced packed path on TPU (or when explicitly
-        # requested), byte path elsewhere (2.4x faster under CPU XLA —
-        # engine routing); both neighborhoods pack, binary states only
-        # (C>=3 decays on the byte path). An explicit sparse request
-        # passes through to the activity-tiled engine.
-        _route_rule((explicitly_packed or platform == "tpu")
-                    and rule.states == 2, "bit-sliced packed")
+        # LtL: bit-sliced packed path (binary) / bit-plane stack (C >= 3
+        # decay) on explicit request; on TPU auto, binary rides packed
+        # (measured) while C >= 3 stays on the byte path until the plane
+        # path has an on-chip number (engine routing). An explicit sparse
+        # request passes through to the activity-tiled engine.
+        _route_rule(explicitly_packed
+                    or (platform == "tpu" and rule.states == 2),
+                    "bit-sliced packed" if rule.states == 2
+                    else "bit-plane packed")
 
     def sync(x) -> int:
         """Force completion: block (a no-op on the tunnel), then fetch a
@@ -293,9 +295,10 @@ def run_bench(args) -> None:
         from gameoflifewithactors_tpu.models import seeds as seeds_lib
 
         grid = seeds_lib.seeded((side, side), "gosper_gun", side // 2, side // 2)
-    elif isinstance(rule, GenRule):
-        # uniform 0..C-1 state soup for multi-state rules, both layouts —
-        # keeps dense-vs-packed comparisons apples-to-apples
+    elif getattr(rule, "states", 2) > 2:
+        # uniform 0..C-1 state soup for multi-state rules (Generations
+        # and C >= 3 LtL), every layout — keeps dense-vs-packed
+        # comparisons apples-to-apples
         grid = rng.integers(0, rule.states, size=(side, side), dtype=np.uint8)
     else:
         grid = rng.integers(0, 2, size=(side, side), dtype=np.uint8)
@@ -332,11 +335,27 @@ def run_bench(args) -> None:
             s, int(n), rule=rule, topology=Topology.TORUS,
             interpret=interpret, donate=True)
     elif isinstance(rule, LtLRule) and args.backend == "packed":
-        from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
+        if rule.states >= 3:
+            # multi-state decay: the bit-plane stack driven by radius-r
+            # interval counts (ops/packed_ltl.step_ltl_planes)
+            from gameoflifewithactors_tpu.ops.packed_generations import (
+                pack_generations_for,
+            )
+            from gameoflifewithactors_tpu.ops.packed_ltl import (
+                multi_step_ltl_planes,
+            )
 
-        state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
-        run = lambda s, n: multi_step_ltl_packed(
-            s, n, rule=rule, topology=Topology.TORUS, donate=True)
+            state = pack_generations_for(jnp.asarray(grid), rule)
+            run = lambda s, n: multi_step_ltl_planes(
+                s, n, rule=rule, topology=Topology.TORUS, donate=True)
+        else:
+            from gameoflifewithactors_tpu.ops.packed_ltl import (
+                multi_step_ltl_packed,
+            )
+
+            state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
+            run = lambda s, n: multi_step_ltl_packed(
+                s, n, rule=rule, topology=Topology.TORUS, donate=True)
     elif args.backend == "packed":
         state = jnp.asarray(bitpack.pack_np(np.asarray(grid)))
         run = lambda s, n: multi_step_packed(s, n, rule=rule, topology=Topology.TORUS,
@@ -411,7 +430,7 @@ def run_bench(args) -> None:
             gens = max(10, min(16384, int(4.0 * gens / dt)))
 
     seed_note = ("gosper-gun" if args.backend == "sparse"
-                 else "uniform state soup" if isinstance(rule, GenRule)
+                 else "uniform state soup" if getattr(rule, "states", 2) > 2
                  else "50% soup")
     print(json.dumps({
         "metric": f"cell-updates/sec/chip, {side}x{side} {rule.notation} ({args.backend}, {seed_note}, {platform})",

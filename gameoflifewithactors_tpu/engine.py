@@ -127,11 +127,6 @@ class Engine:
                 "backends only (mesh + backend='packed'/'pallas'/'auto' for "
                 "3x3 binary rules, mesh + backend='pallas' for Generations "
                 "and LtL)")
-        if self._ltl and backend == "sparse" and mesh is not None:
-            raise ValueError(
-                "sharded sparse serves life-like and Generations rules; "
-                f"LtL sparse ({self.rule.notation}) is single-device — "
-                "drop the mesh or use backend='packed'")
         self.topology = topology
         self.mesh = mesh
         self.backend = backend
@@ -164,15 +159,23 @@ class Engine:
         self._ltl_packed = (self._ltl
                             and backend in ("packed", "sparse", "pallas")
                             and _packs and self.rule.states == 2)
-        if self._ltl and backend == "sparse" and not self._ltl_packed:
+        # multi-state (C >= 3) LtL: bit-plane stack (the Generations
+        # layout driven by radius-r interval counts, ops/packed_ltl.py
+        # step_ltl_planes) — the packed/sparse face of the decay family
+        # the dense byte path serves
+        self._ltl_planes = (self._ltl and self.rule.states >= 3
+                            and backend in ("packed", "sparse") and _packs)
+        if self._ltl and backend == "sparse" and not (
+                self._ltl_packed or self._ltl_planes):
             # an explicit sparse request that sparse cannot serve must not
             # silently become a dense run
             raise ValueError(
-                f"sparse LtL needs a binary (C0/C2) rule and a width "
-                f"divisible by 32, got {self.rule.notation} on "
-                f"{self.shape}; use backend='dense'")
+                f"sparse LtL needs a width divisible by "
+                f"{bitpack.WORD * _pack_cols} (32-cell words must shard "
+                f"whole over {_ny} mesh column(s)), got "
+                f"{self.rule.notation} on {self.shape}; use backend='dense'")
         if (self._ltl and backend in ("packed", "pallas")
-                and not self._ltl_packed):
+                and not (self._ltl_packed or self._ltl_planes)):
             # the bit-sliced/kernel paths can't serve this shape (width
             # not sharding into whole words): fall back to the byte path;
             # self.backend reports what actually runs either way, but only
@@ -201,10 +204,12 @@ class Engine:
                         ) or self._ltl_packed
         # Generations with the packed backend: bit-plane stack
         # (ops/packed_generations.py), ~4x less HBM traffic than the byte
-        # layout; shards as P(None, x, y) with per-plane halo exchange
+        # layout; shards as P(None, x, y) with per-plane halo exchange.
+        # Multi-state LtL shares the layout (and thus the pack/unpack/
+        # population/checkpoint machinery) — only the stepper differs.
         self._gen_packed = (self._generations
                             and backend in ("packed", "pallas", "sparse")
-                            and _packs)
+                            and _packs) or self._ltl_planes
         if self._generations and backend == "sparse" and not self._gen_packed:
             # the sparse engine's Generations layout IS the plane stack;
             # there is no byte-layout sparse path to fall back to
@@ -341,7 +346,18 @@ class Engine:
                         f"smaller than the rule radius {r}: halo exchange "
                         "needs depth <= tile size; use fewer devices"
                     )
-                if self._ltl_packed and backend == "pallas":
+                if backend == "sparse":
+                    # per-tile skipping inside each shard, radius-r halos
+                    # and wake dilation (VERDICT r3 Weak #4); plane-stack
+                    # form for C >= 3 decay
+                    self._run = _tiled_sparse(
+                        sharded.make_multi_step_generations_packed_sparse_tiled
+                        if self._ltl_planes
+                        else sharded.make_multi_step_packed_sparse_tiled)
+                elif self._ltl_planes:
+                    self._run = sharded.make_multi_step_ltl_planes(
+                        mesh, self.rule, topology, donate=True)
+                elif self._ltl_packed and backend == "pallas":
                     self._run = _band_kernel(
                         sharded.make_multi_step_ltl_pallas,
                         sharded.make_multi_step_ltl_packed)
@@ -464,6 +480,12 @@ class Engine:
             from .ops.packed_ltl import multi_step_ltl_packed
 
             self._run = lambda s, n: multi_step_ltl_packed(
+                s, n, rule=self.rule, topology=self.topology, donate=True
+            )
+        elif self._ltl_planes:
+            from .ops.packed_ltl import multi_step_ltl_planes
+
+            self._run = lambda s, n: multi_step_ltl_planes(
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
         elif self._ltl:
@@ -709,17 +731,18 @@ class Engine:
             row_strip = depth * g * (wq // ny) * itemsize
             col_strip = (h // nx + 2 * depth * g) * itemsize
         elif self._gen_packed:
-            # b uint32 bit-planes, each with 1-row / 1-word halos; the
+            # b uint32 bit-planes, each with depth-row / 1-word halos; the
             # band kernel (g > 1) ships g-deep plane strips once per chunk
             # — per-chunk figure here, amortized /g below (same shape as
-            # the LtL branch above)
+            # the LtL branch above). ``depth`` > 1 is the multi-state LtL
+            # plane stack (r halo rows per side, one stacked trip)
             from .ops.packed_generations import n_planes
 
             b = n_planes(self.rule.states)
             wq = w // bitpack.WORD
             itemsize = 4
-            row_strip = b * g * (wq // ny) * itemsize
-            col_strip = b * (h // nx + 2 * g) * itemsize
+            row_strip = b * depth * g * (wq // ny) * itemsize
+            col_strip = b * (h // nx + 2 * depth * g) * itemsize
         elif g > 1:
             # communication-avoiding runner: one exchange of g-deep row
             # strips + 1-word column strips per g generations, amortized
@@ -743,10 +766,16 @@ class Engine:
             # sharded sparse also halo-exchanges its uint32 activity map:
             # per-device (1, 1) flags cost 4-byte row / 12-byte col strips;
             # the tiled map's strips scale with the local tile-map dims
-            fy, fx = (self._flags.shape
-                      if getattr(self, "_sparse_tiles", None) else (nx, ny))
-            total += (row_sends * (fx // ny) * 4
-                      + col_sends * (fy // nx + 2) * 4)
+            # and, for radius-r rules, with the tile-ring wake radius
+            if getattr(self, "_sparse_tiles", None):
+                from .ops.sparse import _wake_dilation
+
+                fy, fx = self._flags.shape
+                dy, dx = _wake_dilation(self.rule, *self._sparse_tiles)
+            else:
+                (fy, fx), (dy, dx) = (nx, ny), (1, 1)
+            total += (row_sends * dy * (fx // ny) * 4
+                      + col_sends * dx * (fy // nx + 2 * dy) * 4)
         return total
 
     def population(self) -> int:

@@ -95,12 +95,17 @@ def _alive_of(plist):
     return plist[0] & ~higher  # state == 1: low bit set, higher clear
 
 
-def _transition(plist, alive, bits, rule: GenRule):
-    """Next-generation planes from (state planes, alive plane, count bits)."""
+def transition_planes(plist, alive, born_p, keep_p, states: int):
+    """Next-generation planes from precomputed birth/keep masks — the
+    decay state machine shared by every plane-stack family: the 3x3
+    Generations rules (count-equality masks) and multi-state C >= 3 LtL
+    (bit-sliced interval-comparator masks, ops/packed_ltl.py).
+
+    ``born_p``/``keep_p`` are raw predicate planes over the window count;
+    birth applies only where the state is 0 and keep only where alive —
+    the masking happens here so callers can't disagree on it."""
     b = len(plist)
     nonzero = reduce(jnp.bitwise_or, plist)
-    born_p = _mask_plane(bits, rule.born, alive)
-    keep_p = _mask_plane(bits, rule.survive, alive)
 
     kept = alive & keep_p
     one = (~nonzero & born_p) | kept     # cells whose next state is 1
@@ -112,16 +117,23 @@ def _transition(plist, alive, bits, rule: GenRule):
     for p in plist:
         inc.append(p ^ carry)
         carry = p & carry
-    C = rule.states
-    if C != (1 << b):
+    if states != (1 << b):
         # cells that aged to exactly C die (C == 2**b wraps via dropped carry)
         eq_c = reduce(jnp.bitwise_and,
-                      [inc[i] if (C >> i) & 1 else ~inc[i] for i in range(b)])
+                      [inc[i] if (states >> i) & 1 else ~inc[i]
+                       for i in range(b)])
         inc = [p & ~eq_c for p in inc]
 
     out = [aging & inc[i] for i in range(b)]
     out[0] = out[0] | one
     return tuple(out)
+
+
+def _transition(plist, alive, bits, rule: GenRule):
+    """Next-generation planes from (state planes, alive plane, count bits)."""
+    born_p = _mask_plane(bits, rule.born, alive)
+    keep_p = _mask_plane(bits, rule.survive, alive)
+    return transition_planes(plist, alive, born_p, keep_p, rule.states)
 
 
 def _step_plane_list(plist, rule: GenRule, topology: Topology):

@@ -300,3 +300,85 @@ def multi_step_ltl_packed(
     """``n`` generations on a packed grid in one jitted fori_loop."""
     body = lambda _, s: step_ltl_packed(s, rule, topology)
     return jax.lax.fori_loop(0, n, body, p)
+
+
+# ---------------------------------------------------------------------------
+# multi-state (C >= 3) LtL on a bit-plane stack: the Generations decay
+# state machine (ops/packed_generations.transition_planes) driven by the
+# radius-r bit-sliced window counts of the ALIVE plane — the dense byte
+# path (ops/ltl.py step_ltl_ext multistate branch) bit-sliced, ~b/1 bytes
+# per cell instead of 1, every op 32 cells wide on the VPU.
+# ---------------------------------------------------------------------------
+
+
+def _interval_masks(alive, counts, rule: LtLRule):
+    """Raw (born_p, keep_p) predicate planes over the bit-sliced window
+    counts — the interval-comparator face of packed_generations'
+    count-equality masks; masking to dead/alive cells happens inside
+    transition_planes."""
+    if not rule.middle:
+        counts = bs_sub_bit(counts, alive)
+
+    def in_any(intervals):
+        hit = None
+        for lo, hi in intervals:
+            t = bs_ge(counts, lo) & ~bs_ge(counts, hi + 1)
+            hit = t if hit is None else (hit | t)
+        return jnp.zeros_like(alive) if hit is None else hit
+
+    return in_any(rule.born_intervals), in_any(rule.survive_intervals)
+
+
+def _require_multistate(rule: LtLRule) -> None:
+    if rule.states < 3:
+        raise ValueError(
+            f"the plane-stack LtL path serves C >= 3 decay rules; "
+            f"{rule.notation} is binary — use the 1-bit packed path "
+            "(step_ltl_packed)")
+
+
+def step_ltl_planes(plist, rule: LtLRule, topology: Topology):
+    """One generation on a tuple of b (H, W/32) state planes (the
+    Generations plane encoding, ops/packed_generations.pack_generations_for
+    with this rule): only state 1 excites, so the window counts run over
+    the alive plane; decay rides transition_planes."""
+    from .packed_generations import _alive_of, transition_planes
+
+    _require_multistate(rule)
+    alive = _alive_of(plist)
+    counts = neighborhood_counts_packed(alive, rule, topology, topology)
+    born_p, keep_p = _interval_masks(alive, counts, rule)
+    return transition_planes(plist, alive, born_p, keep_p, rule.states)
+
+
+def step_ltl_planes_ext(ext_list, rule: LtLRule):
+    """One generation from b halo-extended (h + 2r, wp + 2) planes ->
+    interior (h, wp) plane tuple — r halo rows and one halo word per side
+    (32 >= r cells), same contract as :func:`step_ltl_packed_ext`; halos
+    come from the caller (sharded ppermute, or the sparse window gather)."""
+    from .packed_generations import _alive_of, transition_planes
+
+    _require_multistate(rule)
+    r = rule.radius
+    alive_ext = _alive_of(ext_list)
+    counts = [c[r:-r, 1:-1] for c in neighborhood_counts_packed(
+        alive_ext, rule, Topology.DEAD, Topology.DEAD)]
+    interior = tuple(p[r:-r, 1:-1] for p in ext_list)
+    born_p, keep_p = _interval_masks(alive_ext[r:-r, 1:-1], counts, rule)
+    return transition_planes(interior, alive_ext[r:-r, 1:-1], born_p,
+                             keep_p, rule.states)
+
+
+@optionally_donated("planes")
+def multi_step_ltl_planes(
+    planes: jax.Array,
+    n: jax.Array,
+    *,
+    rule: LtLRule,
+    topology: Topology = Topology.TORUS,
+) -> jax.Array:
+    """``n`` generations on a (b, H, W/32) plane stack in one fori_loop."""
+    b = planes.shape[0]
+    body = lambda _, s: step_ltl_planes(s, rule, topology)
+    out = jax.lax.fori_loop(0, n, body, tuple(planes[i] for i in range(b)))
+    return jnp.stack(out)

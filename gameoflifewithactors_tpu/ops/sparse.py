@@ -48,11 +48,17 @@ from .stencil import Topology
 
 def _step_window(window, rule):
     """One generation of a halo-extended window in any layout: a
-    (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL) or a
-    (b, tr+2, tw+2) Generations bit-plane stack (leading plane axis)."""
+    (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL), a
+    (b, tr+2, tw+2) Generations bit-plane stack, or a (b, tr+2r, tw+2)
+    multi-state LtL plane stack (leading plane axis)."""
     from ..models.ltl import LtLRule
 
     if isinstance(rule, LtLRule):
+        if window.ndim == 3:
+            from .packed_ltl import step_ltl_planes_ext
+
+            return jnp.stack(step_ltl_planes_ext(
+                tuple(window[i] for i in range(window.shape[0])), rule))
         from .packed_ltl import step_ltl_packed_ext
 
         return step_ltl_packed_ext(window, rule)
@@ -375,11 +381,15 @@ class SparseEngineState:
         self._adaptive = capacity is None
         from ..models.ltl import LtLRule
 
-        if isinstance(rule, LtLRule) and rule.states != 2:
+        if isinstance(rule, LtLRule) and rule.states != 2 and packed.ndim != 3:
+            # C >= 3 LtL sparse runs on the (b, H, Wp) plane stack
+            # (pack_generations_for with this rule); a 2D bitboard cannot
+            # carry the decay states
             raise ValueError(
-                f"sparse LtL is binary (the windows are 1-bit packed); "
-                f"{rule.notation} has {rule.states} states — use the "
-                "dense backend")
+                f"sparse multi-state LtL ({rule.notation}, "
+                f"{rule.states} states) takes a (b, H, W/32) bit-plane "
+                "stack, not a 2D bitboard — pack with "
+                "ops.packed_generations.pack_generations_for")
         if _births_from_nothing(rule):
             raise ValueError(
                 f"sparse backend cannot run birth-from-nothing rules "

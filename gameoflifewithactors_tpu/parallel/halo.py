@@ -93,17 +93,26 @@ def exchange_rows_stack(stack: jax.Array, nx: int, topology: Topology,
     return jnp.concatenate([north, stack, south], axis=1)
 
 
+def exchange_cols_stack(ext: jax.Array, ny: int, topology: Topology,
+                        depth: int = 1) -> jax.Array:
+    """(b, h', w) row-extended stack -> (b, h', w+2d): the column half of
+    :func:`exchange_halo_stack`, separated so depth can differ per axis
+    (the radius-r LtL plane layout ships r halo rows but one halo word)."""
+    wrap = topology is Topology.TORUS
+    west = lax.ppermute(ext[:, :, -depth:], COL_AXIS, _shift_perm(ny, +1, wrap))
+    east = lax.ppermute(ext[:, :, :depth], COL_AXIS, _shift_perm(ny, -1, wrap))
+    return jnp.concatenate([west, ext, east], axis=2)
+
+
 def exchange_halo_stack(stack: jax.Array, nx: int, ny: int, topology: Topology,
                         depth: int = 1) -> jax.Array:
     """(b, h, w) plane stack -> (b, h+2d, w+2d): the same two-phase trip as
     :func:`exchange_halo`, but one ppermute per side carries ALL b planes
     (payload (b, d, w)) instead of b separate sends — 4 collectives per
     generation for the bit-plane Generations layout regardless of b."""
-    wrap = topology is Topology.TORUS
-    ext = exchange_rows_stack(stack, nx, topology, depth=depth)
-    west = lax.ppermute(ext[:, :, -depth:], COL_AXIS, _shift_perm(ny, +1, wrap))
-    east = lax.ppermute(ext[:, :, :depth], COL_AXIS, _shift_perm(ny, -1, wrap))
-    return jnp.concatenate([west, ext, east], axis=2)
+    return exchange_cols_stack(
+        exchange_rows_stack(stack, nx, topology, depth=depth), ny, topology,
+        depth=depth)
 
 
 def exchange_halo(tile: jax.Array, nx: int, ny: int, topology: Topology,

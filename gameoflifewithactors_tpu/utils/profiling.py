@@ -143,16 +143,10 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = sharded.make_multi_step_banded(
             engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, 1)
-    elif getattr(engine, "_ltl_packed", False):
-        step1 = sharded.make_multi_step_ltl_packed(
-            engine.mesh, engine.rule, engine.topology)
-        lowered = step1.lower(engine.state, 1)
-    elif getattr(engine, "_ltl", False):
-        step1 = sharded.make_multi_step_ltl(engine.mesh, engine.rule, engine.topology)
-        lowered = step1.lower(engine.state, 1)
     elif getattr(engine, "_sparse_tiles", None):
-        # per-tile sharded sparse (either layout): the flag-map halo rides
-        # along, so lower the same runner the engine steps with
+        # per-tile sharded sparse (any layout, incl. radius-r LtL): the
+        # flag-map halo rides along, so lower the same runner the engine
+        # steps with — before the per-family branches, which would miss it
         tr, tw = engine._sparse_tiles
         make = (sharded.make_multi_step_generations_packed_sparse_tiled
                 if getattr(engine, "_gen_packed", False)
@@ -160,6 +154,17 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = make(engine.mesh, engine.rule, engine.topology,
                      tile_rows=tr, tile_words=tw)
         lowered = step1.lower(engine.state, engine._flags, 1)
+    elif getattr(engine, "_ltl_planes", False):
+        step1 = sharded.make_multi_step_ltl_planes(
+            engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_ltl_packed", False):
+        step1 = sharded.make_multi_step_ltl_packed(
+            engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
+    elif getattr(engine, "_ltl", False):
+        step1 = sharded.make_multi_step_ltl(engine.mesh, engine.rule, engine.topology)
+        lowered = step1.lower(engine.state, 1)
     elif getattr(engine, "_gen_packed", False):
         step1 = sharded.make_multi_step_generations_packed(
             engine.mesh, engine.rule, engine.topology)

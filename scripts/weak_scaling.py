@@ -47,6 +47,17 @@ def main() -> None:
                     help="G>1 uses the communication-avoiding runner (one "
                          "depth-G halo exchange per G generations; "
                          "sharded.make_multi_step_packed_deep)")
+    ap.add_argument("--runner", default="packed",
+                    choices=["packed", "band", "sparse-tiled"],
+                    help="sharded runner under test: 'packed' (per-gen XLA "
+                         "SWAR; G>1 switches to the communication-avoiding "
+                         "deep runner), 'band' (the slab-kernel row-band "
+                         "path auto serves on TPU — interpret mode off-TPU, "
+                         "so CPU numbers measure the composition's "
+                         "plumbing, not the kernel), 'sparse-tiled' "
+                         "(per-tile activity skipping; each device seeded "
+                         "one soup blob so per-device activity is constant "
+                         "across the sweep)")
     args = ap.parse_args()
 
     import jax
@@ -97,11 +108,57 @@ def main() -> None:
         mesh = mesh_lib.make_mesh(None, devices[:n])
         nx, ny = mesh.shape[mesh_lib.ROW_AXIS], mesh.shape[mesh_lib.COL_AXIS]
         H, W = nx * th, ny * tw
-        grid = rng.integers(0, 2, size=(H, W), dtype=np.uint8)
-        p = mesh_lib.device_put_sharded_grid(
-            jnp.asarray(bitpack.pack_np(grid)), mesh)
         g = args.gens_per_exchange
-        if g > 1:
+        if args.runner == "sparse-tiled":
+            # one soup blob per device tile (1/64 of its area): per-device
+            # activity stays constant across the sweep, so the efficiency
+            # ratio isolates the runner's collectives (grid + activity-map
+            # halos), which is the point of weak scaling
+            grid = np.zeros((H, W), dtype=np.uint8)
+            bh, bw = max(1, th // 8), max(1, tw // 8)
+            for iy in range(nx):
+                for ix in range(ny):
+                    r0, c0 = iy * th + th // 4, ix * tw + tw // 4
+                    grid[r0:r0 + bh, c0:c0 + bw] = rng.integers(
+                        0, 2, size=(bh, bw), dtype=np.uint8)
+        else:
+            grid = rng.integers(0, 2, size=(H, W), dtype=np.uint8)
+        packed = jnp.asarray(bitpack.pack_np(grid))
+        p = mesh_lib.device_put_sharded_grid(
+            packed, mesh, banded=args.runner == "band" and ny > 1)
+        if args.runner == "band":
+            from gameoflifewithactors_tpu.ops.pallas_stencil import (
+                default_interpret,
+            )
+
+            gb = g if g > 1 else 8
+            if args.gens % gb:
+                raise SystemExit(f"--gens must be a multiple of G={gb}")
+            band = sharded.make_multi_step_pallas(
+                mesh, rule, Topology.TORUS, gens_per_exchange=gb,
+                interpret=default_interpret())
+            run = lambda s_, n: band(s_, n // gb)
+            g = gb
+        elif args.runner == "sparse-tiled":
+            from gameoflifewithactors_tpu.ops.sparse import auto_tile
+
+            if g > 1:
+                # no communication-avoiding variant exists for this
+                # runner; silently recording G>1 would label identical
+                # runs as different configurations
+                raise SystemExit(
+                    "--gens-per-exchange applies to the packed and band "
+                    "runners, not sparse-tiled")
+            tr, twords = auto_tile(th, tw // bitpack.WORD)
+            truns = sharded.make_multi_step_packed_sparse_tiled(
+                mesh, rule, Topology.TORUS, tile_rows=tr, tile_words=twords)
+            act_cell = [sharded.initial_tile_activity(
+                packed, mesh, tr, twords)]
+
+            def run(s_, n):
+                s_, act_cell[0] = truns(s_, act_cell[0], n)
+                return s_
+        elif g > 1:
             deep = sharded.make_multi_step_packed_deep(
                 mesh, rule, Topology.TORUS, gens_per_exchange=g)
             run = lambda s_, n: deep(s_, n // g)
@@ -125,17 +182,24 @@ def main() -> None:
         eff = (best / n) / (base[1] / base[0])
         rec = {
             "devices": n, "mesh": [nx, ny], "grid": [H, W],
+            "runner": args.runner,
             "cell_updates_per_sec": best,
             "per_device": best / n,
             "weak_scaling_efficiency": eff,
             "platform": platform,
         }
+        if args.runner == "sparse-tiled":
+            # the rate above counts every grid cell; most are asleep by
+            # design, so record the activity too for honest reading
+            rec["active_tiles"] = int(jnp.sum(act_cell[0]))
+            rec["total_tiles"] = int(act_cell[0].size)
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
     print(json.dumps({
         "metric": f"weak-scaling efficiency, {th}x{tw}/device, {rule.notation} "
-                  f"({platform}, G={args.gens_per_exchange})",
+                  f"({platform}, runner={args.runner}, "
+                  f"G={args.gens_per_exchange})",
         "value": results[-1]["weak_scaling_efficiency"],
         "unit": "fraction",
         "devices": results[-1]["devices"],

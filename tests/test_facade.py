@@ -190,11 +190,14 @@ def test_engine_pallas_backend():
     e.step(8)
     np.testing.assert_array_equal(e.snapshot(), np.roll(g, (2, 2), (0, 1)))
     assert e.population() == 5
-    # pallas + mesh is the row-band runner: 2D tile meshes stay rejected
-    # (tests/test_sharding.py TestShardedPallas covers the supported shapes)
-    with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
-        Engine(np.zeros((16, 256), np.uint8), "conway", backend="pallas",
-               mesh=mesh_lib.make_mesh((2, 4)))
+    # pallas + mesh is the row-band runner; 2D meshes flatten into nx*ny
+    # full-width bands (tests/test_sharding.py TestShardedPallas pins the
+    # bit-identity; here just the routing)
+    e2d = Engine(np.zeros((16, 256), np.uint8), "conway", backend="pallas",
+                 mesh=mesh_lib.make_mesh((2, 4)))
+    assert e2d.backend == "pallas" and e2d._banded
+    e2d.step(2)
+    assert e2d.population() == 0
 
 
 def test_auto_backend_resolution_off_tpu():

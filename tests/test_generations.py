@@ -114,13 +114,15 @@ def test_engine_rejects_out_of_range_states_and_packed_kernels():
     with pytest.raises(ValueError, match="states 0..2"):
         Engine(g, "B2/S/C3")
     # pallas (single-device / row bands) and sparse (single-device and
-    # sharded) are real Generations paths now; the one sharded variant
-    # that does not exist still rejects clearly
+    # sharded) are real Generations paths
     from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
 
-    with pytest.raises(ValueError, match=r"\(nx, 1\) row-band"):
-        Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="pallas",
-               mesh=mesh_lib.make_mesh((2, 4)))
+    # 2D meshes flatten into row bands for the Generations kernel too
+    e2d = Engine(np.zeros((16, 256), np.uint8), "B2/S/C3", backend="pallas",
+                 mesh=mesh_lib.make_mesh((2, 4)))
+    assert e2d.backend == "pallas" and e2d._banded
+    e2d.step(2)
+    assert e2d.population() == 0
 
 
 def test_generations_checkpoint_roundtrip(tmp_path):

@@ -39,6 +39,9 @@ CASES = [
     # the packed multi-rule layouts (bit-planes / bit-sliced bitboards)
     ((2, 4), "packed", "brain", Topology.TORUS),
     ((2, 2), "packed", "R2,C0,M0,S3..8,B5..7", Topology.TORUS),
+    # multi-state LtL plane stack: r-row stacked strips, one halo word
+    ((2, 2), "packed", "R2,C4,M1,S3..8,B5..9", Topology.TORUS),
+    ((2, 4), "packed", "R2,C4,M1,S3..8,B5..9", Topology.DEAD),
 ]
 
 
@@ -55,8 +58,14 @@ def test_estimate_matches_compiled_hlo(shape, backend, rule, topology):
         f"(mesh {shape}, {backend}, {rule}, {topology})")
 
 
-def test_sharded_sparse_includes_flag_traffic():
-    eng = Engine(_grid(), rule="B3/S23", topology=Topology.TORUS,
+@pytest.mark.parametrize("rule", [
+    "B3/S23",
+    "brain",                     # plane-stack tiled sparse
+    "R2,C0,M0,S3..8,B5..7",      # radius-r binary LtL tiled sparse
+    "R2,C4,M1,S3..8,B5..9",      # radius-r multi-state plane tiled sparse
+])
+def test_sharded_sparse_includes_flag_traffic(rule):
+    eng = Engine(_grid(), rule=rule, topology=Topology.TORUS,
                  mesh=_mesh((2, 4)), backend="sparse")
     est = eng.halo_bytes_per_gen()
     got = measured_halo_bytes_per_gen(eng)

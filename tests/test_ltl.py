@@ -338,9 +338,11 @@ class TestMultiStateLtL:
         # state validation knows the rule's state count
         with pytest.raises(ValueError, match="states 0..3"):
             Engine(np.full((16, 32), 4, np.uint8), rule)
-        # binary-only fast paths reject clearly
-        with pytest.raises(ValueError, match="binary"):
-            Engine(grid, rule, backend="sparse")
+        # multi-state sparse rides the plane stack now — bit-identical
+        sp = Engine(grid, rule, backend="sparse")
+        assert sp._ltl_planes
+        sp.step(4)
+        np.testing.assert_array_equal(sp.snapshot(), want)
         from gameoflifewithactors_tpu.ops import bitpack
         from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_packed
 

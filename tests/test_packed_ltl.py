@@ -148,3 +148,86 @@ class TestShardedPackedLtL:
         run = sharded.make_multi_step_ltl_packed(m, rule, Topology.TORUS)
         with pytest.raises(ValueError, match="smaller than the rule radius"):
             run(p, 1)
+
+
+class TestMultiStatePlanes:
+    """C >= 3 LtL on the bit-plane stack (ops/packed_ltl.step_ltl_planes):
+    the Generations decay machine driven by radius-r interval counts —
+    bit-identical to the dense byte path (ops/ltl.py multistate branch)."""
+
+    @pytest.mark.parametrize("spec,n", [
+        ("R2,C4,M1,S3..8,B5..9", 12),        # box, C=4
+        ("R3,C5,M0,S6..14,B8..12", 8),       # M0: center excluded
+        ("R2,C3,M0,S6..11,B6..9,NN", 10),    # von Neumann decay
+        ("R1,C6,S2-3,B3,NM", 16),            # HROT list form, C=6
+    ])
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    def test_bit_identity_vs_dense(self, spec, n, topology):
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            pack_generations_for,
+            unpack_generations,
+        )
+        from gameoflifewithactors_tpu.ops.packed_ltl import (
+            multi_step_ltl_planes,
+        )
+
+        rule = parse_any(spec)
+        rng = np.random.default_rng(len(spec))
+        grid = rng.integers(0, rule.states, size=(64, 96), dtype=np.uint8)
+        want = np.asarray(multi_step_ltl(
+            jnp.asarray(grid), n, rule=rule, topology=topology))
+        planes = pack_generations_for(jnp.asarray(grid), rule)
+        got = np.asarray(unpack_generations(
+            multi_step_ltl_planes(planes, n, rule=rule, topology=topology)))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+    def test_sharded_planes_bit_identity(self, topology):
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.packed_generations import (
+            pack_generations_for,
+            unpack_generations,
+        )
+        from gameoflifewithactors_tpu.parallel import mesh as mesh_lib, sharded
+
+        rule = parse_any("R2,C4,M1,S3..8,B5..9")
+        rng = np.random.default_rng(31)
+        grid = rng.integers(0, 4, size=(64, 256), dtype=np.uint8)
+        want = np.asarray(multi_step_ltl(
+            jnp.asarray(grid), 9, rule=rule, topology=topology))
+        m = mesh_lib.make_mesh((2, 4))
+        planes = mesh_lib.device_put_sharded_grid(
+            pack_generations_for(jnp.asarray(grid), rule), m)
+        run = sharded.make_multi_step_ltl_planes(m, rule, topology)
+        got = np.asarray(unpack_generations(run(planes, 9)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_engine_facade_routes_planes(self):
+        from gameoflifewithactors_tpu import Engine
+
+        rng = np.random.default_rng(41)
+        grid = rng.integers(0, 4, size=(64, 96), dtype=np.uint8)
+        ref = Engine(grid, "R2,C4,M1,S3..8,B5..9", backend="dense")
+        got = Engine(grid, "R2,C4,M1,S3..8,B5..9", backend="packed")
+        assert got._ltl_planes and got._gen_packed and not got._ltl_packed
+        ref.step(11)
+        got.step(11)
+        np.testing.assert_array_equal(ref.snapshot(), got.snapshot())
+        assert ref.population() == got.population()
+        # a width that cannot pack still warns down to the dense path
+        import warnings as w
+
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            odd = Engine(np.zeros((32, 48), np.uint8),
+                         "R2,C4,M1,S3..8,B5..9", backend="packed")
+        assert any("dense byte path" in str(c.message) for c in caught)
+        assert not odd._ltl_planes and odd.backend == "dense"
+
+    def test_planes_entry_rejects_binary(self):
+        from gameoflifewithactors_tpu.ops.packed_ltl import step_ltl_planes
+
+        with pytest.raises(ValueError, match="C >= 3"):
+            step_ltl_planes((jnp.zeros((8, 1), jnp.uint32),),
+                            parse_ltl("bosco"), Topology.TORUS)
