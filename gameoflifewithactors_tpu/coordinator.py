@@ -158,7 +158,12 @@ class GridCoordinator:
                     wall_seconds=dt,
                     cell_updates_per_sec=cells / dt if dt > 0 else float("inf"),
                     population=self.population() if self.track_population else None,
-                    halo_bytes=self.engine.halo_bytes_per_gen() * n or None,
+                    # the arithmetic model (pinned == the HLO figure in
+                    # tests/test_halo_bytes.py): the default 'auto' source
+                    # compiles a one-generation step on first use, which
+                    # would stall a live render/metrics loop's first tick
+                    halo_bytes=self.engine.halo_bytes_per_gen(
+                        source="model") * n or None,
                 )
             )
         self._notify()

@@ -121,7 +121,14 @@ def collective_permute_bytes(hlo_text: str) -> int:
         nbytes = _DTYPE_BYTES[dtype]
         for d in filter(None, m.group("dims").split(",")):
             nbytes *= int(d)
-        n_pairs = m.group("pairs").count("},{") + 1
+        # src == dst pairs are device-local self-copies (XLA emits them for
+        # the wrap "send" on a size-1 mesh axis) — bytes that never touch
+        # the interconnect, so they must not count as halo traffic
+        n_pairs = 0
+        for pair in m.group("pairs").split("},{"):
+            src, dst = pair.split(",")
+            if src.strip() != dst.strip():
+                n_pairs += 1
         total += nbytes * n_pairs
     return total
 
@@ -177,6 +184,16 @@ def measured_halo_bytes_per_gen(engine) -> int:
         step1 = sharded.make_multi_step_packed_sparse(
             engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state, engine._flags, 1)
+    elif engine._packed and getattr(engine, "gens_per_exchange", 1) > 1:
+        # communication-avoiding runner: lower ONE depth-g chunk and
+        # amortize over its g generations (ceil, like the model) — the
+        # per-generation runner's figure would overstate what this engine
+        # actually moves
+        g = engine.gens_per_exchange
+        step1 = sharded.make_multi_step_packed_deep(
+            engine.mesh, engine.rule, engine.topology, gens_per_exchange=g)
+        lowered = step1.lower(engine.state, 1)
+        return -(-collective_permute_bytes(lowered.compile().as_text()) // g)
     elif engine._packed:
         step1 = sharded.make_step_packed(engine.mesh, engine.rule, engine.topology)
         lowered = step1.lower(engine.state)

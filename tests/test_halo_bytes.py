@@ -42,6 +42,11 @@ CASES = [
     # multi-state LtL plane stack: r-row stacked strips, one halo word
     ((2, 2), "packed", "R2,C4,M1,S3..8,B5..9", Topology.TORUS),
     ((2, 4), "packed", "R2,C4,M1,S3..8,B5..9", Topology.DEAD),
+    # size-1 mesh axes: XLA emits self-pair permutes for the wrap "send";
+    # those are device-local copies the byte counter must skip (review
+    # finding — the model deliberately counts 0 for a size-1 axis)
+    ((8, 1), "packed", "B3/S23", Topology.TORUS),
+    ((1, 8), "packed", "B3/S23", Topology.TORUS),
 ]
 
 
@@ -50,12 +55,41 @@ CASES = [
 def test_estimate_matches_compiled_hlo(shape, backend, rule, topology):
     eng = Engine(_grid(), rule=rule, topology=topology, mesh=_mesh(shape),
                  backend=backend)
-    est = eng.halo_bytes_per_gen()
+    est = eng.halo_bytes_per_gen(source="model")
     got = measured_halo_bytes_per_gen(eng)
     assert got > 0, "no collective-permute found in the compiled HLO"
     assert got == est, (
         f"halo estimate {est} B/gen != measured {got} B/gen "
         f"(mesh {shape}, {backend}, {rule}, {topology})")
+
+
+def test_default_source_delegates_to_measured_hlo():
+    """halo_bytes_per_gen() serves the HLO-derived figure by default
+    (VERDICT r3 Weak #6), cached; 'model' stays available and agreeing."""
+    eng = Engine(_grid(), rule="B3/S23", topology=Topology.TORUS,
+                 mesh=_mesh((2, 4)), backend="packed")
+    auto = eng.halo_bytes_per_gen()
+    assert auto == eng._halo_hlo == measured_halo_bytes_per_gen(eng)
+    assert auto == eng.halo_bytes_per_gen(source="measured")
+    assert auto == eng.halo_bytes_per_gen(source="model")
+    with pytest.raises(ValueError, match="source"):
+        eng.halo_bytes_per_gen(source="hunch")
+    assert Engine(_grid(64, 64), "B3/S23").halo_bytes_per_gen() == 0
+
+
+def test_deep_engine_measures_amortized_chunk():
+    """A communication-avoiding engine's measured figure lowers the
+    depth-g chunk and amortizes /g — not the per-generation runner, which
+    would overstate what the engine actually moves."""
+    pergen = Engine(_grid(), rule="B3/S23", mesh=_mesh((2, 4)),
+                    backend="packed")
+    deep = Engine(_grid(), rule="B3/S23", mesh=_mesh((2, 4)),
+                  backend="packed", gens_per_exchange=8)
+    # source='measured' so a broken deep branch cannot hide behind auto's
+    # silent model fallback (review finding)
+    d_meas = deep.halo_bytes_per_gen(source="measured")
+    assert d_meas == deep.halo_bytes_per_gen(source="model")
+    assert 0 < d_meas < pergen.halo_bytes_per_gen()
 
 
 @pytest.mark.parametrize("rule", [
@@ -67,7 +101,7 @@ def test_estimate_matches_compiled_hlo(shape, backend, rule, topology):
 def test_sharded_sparse_includes_flag_traffic(rule):
     eng = Engine(_grid(), rule=rule, topology=Topology.TORUS,
                  mesh=_mesh((2, 4)), backend="sparse")
-    est = eng.halo_bytes_per_gen()
+    est = eng.halo_bytes_per_gen(source="model")
     got = measured_halo_bytes_per_gen(eng)
     assert got == est, f"sparse halo estimate {est} != measured {got}"
 
@@ -92,7 +126,7 @@ def test_band_estimate_matches_compiled_hlo(shape, rule, topology):
     on flattened 2D meshes (the figure the facade test defers to)."""
     eng = Engine(_grid(), rule=rule, topology=topology, mesh=_mesh(shape),
                  backend="pallas", gens_per_exchange=2)
-    est = eng.halo_bytes_per_gen()
+    est = eng.halo_bytes_per_gen(source="model")
     got = measured_halo_bytes_per_gen(eng)
     assert got > 0, "no collective-permute found in the compiled HLO"
     assert got == est, (
@@ -109,11 +143,13 @@ def test_ltl_band_estimate_matches_per_gen_rate():
     pergen = Engine(g, "R2,C0,M1,S9..16,B8..12", mesh=m, backend="packed")
     band = Engine(g, "R2,C0,M1,S9..16,B8..12", mesh=m, backend="pallas",
                   gens_per_exchange=2)
-    assert band.halo_bytes_per_gen() == pergen.halo_bytes_per_gen() > 0
+    assert (band.halo_bytes_per_gen(source="model")
+            == pergen.halo_bytes_per_gen(source="model") > 0)
     # the Generations band twin amortizes to the per-gen plane rate too
     gp = Engine(g, "brain", mesh=m, backend="packed")
     gb = Engine(g, "brain", mesh=m, backend="pallas", gens_per_exchange=2)
-    assert gb.halo_bytes_per_gen() == gp.halo_bytes_per_gen() > 0
+    assert (gb.halo_bytes_per_gen(source="model")
+            == gp.halo_bytes_per_gen(source="model") > 0)
 
 
 def test_unsharded_engine_moves_nothing():
@@ -128,10 +164,12 @@ def test_parser_on_synthetic_hlo():
   %cp.1 = u32[1,8]{1,0} collective-permute(%a), channel_id=1, source_target_pairs={{0,2},{2,0}}
   %cp.2 = (u8[3,66]{1,0}, u8[3,66]{1,0}, u32[], u32[]) collective-permute-start(%b), source_target_pairs={{1,3}}
   %done = u8[3,66]{1,0} collective-permute-done(%cp.2)
+  %cp.3 = u32[2]{0} collective-permute(%c), source_target_pairs={{0,0},{1,1},{2,3}}
 """
     # cp.1: 32 B x 2 pairs; cp.2 (TPU async tuple form): operand element
-    # 198 B x 1 pair counted once; -done and the add contribute nothing
-    assert collective_permute_bytes(txt) == 32 * 2 + 198
+    # 198 B x 1 pair counted once; cp.3: only the 2->3 pair counts (the
+    # self-pairs are device-local copies); -done and the add contribute 0
+    assert collective_permute_bytes(txt) == 32 * 2 + 198 + 8
 
 
 def test_parser_rejects_unknown_dtype():
