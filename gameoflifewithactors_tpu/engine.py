@@ -580,22 +580,36 @@ class Engine:
         meshes flatten, parallel/sharded.py) on TPU, either topology; the
         packed SWAR path everywhere else. Off
         'packed', Generations rules take the bit-plane stack when the width
-        packs (% 32), the byte path otherwise; LtL picks bit-sliced packed
-        on TPU and the byte path elsewhere (see the platform note below)."""
+        packs (% 32), the byte path otherwise; binary LtL picks bit-sliced
+        packed on TPU and the byte path elsewhere; C >= 3 LtL picks the
+        plane stack on CPU for diamonds and box radius <= 3 (measured
+        crossover — see the notes in the LtL branch below), the byte path
+        otherwise."""
         if self._ltl:
-            # the bit-sliced LtL path wins on the TPU VPU but measured
-            # ~2.4x slower than the byte path under XLA's CPU lowering;
-            # pick per platform (explicit backend='packed' still forces it).
-            # Both neighborhoods pack (the diamond sum is per-row
+            # Binary: the bit-sliced path wins on the TPU VPU but measured
+            # ~2.4x slower than the byte path under XLA's CPU lowering —
+            # pick per platform (explicit backend='packed' still forces
+            # it). Both neighborhoods pack (the diamond sum is per-row
             # separable). The width must shard into whole words across the
             # mesh columns, or the constructor would immediately walk the
             # choice back to dense.
             on_tpu = not pallas_stencil.default_interpret()
             shape = np.shape(grid)
             ny = mesh.shape[mesh_lib.COL_AXIS] if mesh is not None else 1
-            if (on_tpu and len(shape) == 2
-                    and shape[1] % (bitpack.WORD * ny) == 0
-                    and self.rule.states == 2):
+            packs = (len(shape) == 2
+                     and shape[1] % (bitpack.WORD * ny) == 0)
+            if self.rule.states == 2:
+                return "packed" if on_tpu and packs else "dense"
+            # C >= 3 plane stack vs dense byte path, measured on CPU
+            # (2026-07-31, 1024² uniform soup, this host): planes wins
+            # 2.0-6.5x for box radius <= 3 and 3.3-11x for EVERY diamond
+            # (the dense diamond's cumsum assembly is the slow part);
+            # dense wins 1.2-1.5x for box radius >= 4. On TPU the C >= 3
+            # choice stays dense until the ltl_planes worklist item
+            # captures both rates on chip (evidence-routed, like binary).
+            if (not on_tpu and packs
+                    and (self.rule.neighborhood == "N"
+                         or self.rule.radius <= 3)):
                 return "packed"
             return "dense"
         if self._generations:

@@ -20,7 +20,7 @@ export WATCH_T0
 # natively (VERDICT r3 Missing #1) — a first-ever Mosaic compile is the
 # likeliest to need a fix-and-retry loop, so they burn the front of the
 # window; then the autotune + trace (VERDICT #2/#3), then recaptures.
-ITEMS=pallas_generations,ltl_pallas,pallas_autotune,profile_trace,pallas_band,bench_packed,ltl_bosco,generations_brain,sparse_tiled,elementary,config5_sparse,pallas_identity,ltl_lowering
+ITEMS=pallas_generations,ltl_pallas,ltl_planes,pallas_autotune,profile_trace,pallas_band,bench_packed,ltl_bosco,generations_brain,sparse_tiled,elementary,config5_sparse,pallas_identity,ltl_lowering
 export ITEMS
 trap 'rm -f "${PROBE_OUT:-}"' EXIT
 

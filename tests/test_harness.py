@@ -162,7 +162,8 @@ def test_worklist_children_smoke_cpu():
     # (the same reason bench.py strips it for its CPU fallback child)
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "WORKLIST_SMOKE": "1",
            "PYTHONPATH": axon_guard.strip_pythonpath()}
-    for item in ("sparse_tiled", "elementary", "profile_trace"):
+    for item in ("sparse_tiled", "elementary", "profile_trace",
+                 "ltl_planes"):
         r = subprocess.run(
             [sys.executable, "scripts/tpu_worklist.py", "--item", item],
             capture_output=True, text=True, timeout=420, env=env,

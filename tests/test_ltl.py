@@ -328,8 +328,8 @@ class TestMultiStateLtL:
         rule = parse_ltl("R2,C4,M1,S3..8,B5..9")
         rng = np.random.default_rng(79)
         grid = rng.integers(0, 4, size=(48, 64), dtype=np.uint8)
-        e = Engine(grid, rule)                       # auto -> dense
-        assert e.backend == "dense"
+        e = Engine(grid, rule)       # auto -> packed planes (r=2 box on CPU)
+        assert e.backend == "packed" and e._ltl_planes
         e.step(4)
         want = self._oracle(grid, rule, 4, wrap=True)
         np.testing.assert_array_equal(e.snapshot(), want)
@@ -500,3 +500,21 @@ class TestHROTIntervalLists:
                                     interpret=True, block_rows=16,
                                     gens_per_call=2)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_auto_routes_multistate_by_measured_crossover():
+    """C >= 3 auto routing on CPU follows the measured planes-vs-dense
+    crossover: planes for diamonds and box radius <= 3, dense for box
+    radius >= 4 (engine._resolve_auto cites the measurements)."""
+    from gameoflifewithactors_tpu import Engine
+
+    g4 = np.random.default_rng(5).integers(0, 4, size=(32, 64),
+                                           dtype=np.uint8)
+    assert Engine(g4, "R2,C4,M1,S3..8,B5..9").backend == "packed"
+    assert Engine(g4, "R3,C4,M1,S10..20,B14..19").backend == "packed"
+    assert Engine(g4, "R5,C4,M1,S34..58,B34..45").backend == "dense"
+    assert Engine(g4, "R5,C4,M0,S20..40,B25..38,NN").backend == "packed"
+    # width that cannot pack: planes unavailable, dense serves
+    g_odd = np.random.default_rng(5).integers(0, 4, size=(32, 48),
+                                              dtype=np.uint8)
+    assert Engine(g_odd, "R2,C4,M1,S3..8,B5..9").backend == "dense"
