@@ -67,14 +67,36 @@ def global_mesh(shape: Optional[Tuple[int, int]] = None,
     return make_mesh(shape, list(devices if devices is not None else jax.devices()))
 
 
-def put_global_grid(grid: np.ndarray, mesh: Mesh) -> jax.Array:
+def put_global_grid(grid: np.ndarray, mesh: Mesh,
+                    banded: bool = False) -> jax.Array:
     """Place a host grid (same full copy on every process) onto ``mesh``.
 
     Each process materialises only the shards its addressable devices own,
-    so the host copy is the only O(grid) cost — nothing is sent twice."""
+    so the host copy is the only O(grid) cost — nothing is sent twice.
+    ``banded=True`` uses the flattened full-width row-band layout the
+    band-kernel runners take on 2D meshes (mesh.device_put_sharded_grid's
+    contract); 3D (b, H, Wp) plane stacks replicate the leading axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import COL_AXIS, ROW_AXIS
+
     grid = np.asarray(grid)
-    check_divisible(grid.shape, mesh)
-    sharding = grid_sharding(mesh)
+    if banded:
+        nb = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+        if grid.shape[-2] % nb:
+            raise ValueError(
+                f"grid rows {grid.shape[-2]} not divisible into {nb} "
+                "full-width bands over the flattened mesh")
+        spec = (P(None, (ROW_AXIS, COL_AXIS), None) if grid.ndim == 3
+                else P((ROW_AXIS, COL_AXIS), None))
+        sharding = NamedSharding(mesh, spec)
+    elif grid.ndim == 3:
+        check_divisible(grid.shape[1:], mesh)
+        sharding = NamedSharding(
+            mesh, P(None, ROW_AXIS, COL_AXIS))
+    else:
+        check_divisible(grid.shape, mesh)
+        sharding = grid_sharding(mesh)
     return jax.make_array_from_callback(grid.shape, sharding,
                                         lambda idx: grid[idx])
 

@@ -97,6 +97,39 @@ def main() -> None:
     n_awake = int(multihost.gather_global(sact).sum())
     assert 0 < n_awake < act_np.size, n_awake  # gun corner only
 
+    # flattened-band kernel path on the genuine 2D (2, n) mesh: the band
+    # ppermutes ride the flattened ('x', 'y') axis ACROSS processes
+    # (round-4 feature — config #3's mesh shape with the native path)
+    fb_grid = seeds.seeded((8 * 2 * n_procs, 64), "glider", 1, 1)
+    fb_packed = bitpack.pack_np(fb_grid)
+    fb_run = sharded.make_multi_step_pallas(mesh, CONWAY, gens_per_exchange=8)
+    fb_out = multihost.gather_global(fb_run(
+        multihost.put_global_grid(fb_packed, mesh, banded=True), 3))
+    fb_want = np.asarray(multi_step_packed(
+        jnp.asarray(fb_packed), 24, rule=CONWAY, topology=Topology.TORUS))
+    np.testing.assert_array_equal(fb_out, fb_want)
+
+    # multi-state (C >= 3) LtL plane stack across processes: ONE stacked
+    # ppermute of r halo rows + 1 halo word per side crosses the boundary
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops.packed_generations import (
+        pack_generations_for,
+        unpack_generations,
+    )
+    from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_planes
+
+    mrule = parse_any("R2,C4,M1,S3..8,B5..9")
+    rng = np.random.default_rng(9)  # same seed => same grid on every proc
+    mgrid = rng.integers(0, 4, size=(32, 64 * n_procs), dtype=np.uint8)
+    mplanes = np.asarray(pack_generations_for(jnp.asarray(mgrid), mrule))
+    mrun = sharded.make_multi_step_ltl_planes(mesh, mrule, Topology.TORUS)
+    mout = multihost.gather_global(mrun(
+        multihost.put_global_grid(mplanes, mesh), 6))
+    mwant = np.asarray(multi_step_ltl_planes(
+        jnp.asarray(mplanes), 6, rule=mrule, topology=Topology.TORUS))
+    np.testing.assert_array_equal(mout, mwant)
+    assert (np.asarray(unpack_generations(jnp.asarray(mout))) < 4).all()
+
     # sharded elementary (rows DP x width CP) across processes: the halo
     # word crosses the process boundary every chunk
     from gameoflifewithactors_tpu.models.elementary import parse_elementary
