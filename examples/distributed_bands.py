@@ -1,10 +1,11 @@
 """Distributed stepping over every visible device, with throughput.
 
-Demonstrates the two sharded fast paths on whatever mesh the machine
-offers: the 2D-tiled SWAR runner, and — on (N, 1) row-band layouts — the
-native-kernel band runner (interpret mode off-TPU, Mosaic on TPU). Run on
-the 8-virtual-device CPU rig to see the multi-chip code paths without
-hardware:
+Demonstrates the sharded fast paths on whatever mesh the machine offers:
+the 2D-tiled SWAR runner, the (N, 1) row-band native-kernel runner, and —
+the path a real v5e-8 takes by default — the SAME kernel on the 2D mesh
+via flattened full-width bands (interpret mode off-TPU, Mosaic on TPU).
+Run on the 8-virtual-device CPU rig to see the multi-chip code paths
+without hardware:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/distributed_bands.py --side 512 --gens 64
@@ -35,12 +36,13 @@ def main(argv=None) -> None:
     grid = (np.random.default_rng(1)
             .integers(0, 2, size=(args.side, args.side), dtype=np.uint8))
 
-    for shape, label in ((mesh_lib.factor2d(n), "2D tiles / SWAR"),
-                         ((n, 1), "row bands / native kernel")):
+    for shape, backend, label in (
+            (mesh_lib.factor2d(n), "packed", "2D tiles / SWAR"),
+            ((n, 1), "pallas", "row bands / native kernel"),
+            (mesh_lib.factor2d(n), "pallas", "2D mesh / flattened bands")):
         m = mesh_lib.make_mesh(shape, jax.devices())
-        backend = "pallas" if shape[1] == 1 else "packed"
         eng = Engine(grid, args.rule, mesh=m, backend=backend,
-                     gens_per_exchange=8 if shape[1] == 1 else 1)
+                     gens_per_exchange=8 if backend == "pallas" else 1)
         eng.step(8)                      # compile + warm
         eng.block_until_ready()
         t0 = time.perf_counter()
