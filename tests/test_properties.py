@@ -114,3 +114,56 @@ def test_pack_roundtrip_random(shape, seed):
         np.asarray(bitpack.unpack(bitpack.pack(jnp.asarray(g)))), g)
     np.testing.assert_array_equal(bitpack.pack_np(g),
                                   np.asarray(bitpack.pack(jnp.asarray(g))))
+
+
+# -- multi-state LtL plane stack vs dense byte path ---------------------------
+
+def _mk_multistate(radius, states, middle, hood, b_lo, b_w, s_lo, s_w):
+    from gameoflifewithactors_tpu.models.ltl import LtLRule
+
+    # intervals clamp to the rule's OWN window (the diamond's is smaller
+    # than the box's); born avoids 0 (birth-from-nothing is a different
+    # contract, rejected by the sparse paths)
+    win = (2 * radius + 1) ** 2 if hood == "M" else 2 * radius * (radius + 1) + 1
+    b_lo = min(max(1, b_lo), win)
+    s_lo = min(s_lo, win)
+    return LtLRule(radius=radius, states=states, middle=middle,
+                   neighborhood=hood,
+                   born=(b_lo, min(b_lo + b_w, win)),
+                   survive=(s_lo, min(s_lo + s_w, win)))
+
+
+_ltl_multistate = st.builds(
+    _mk_multistate,
+    radius=st.integers(1, 3),
+    states=st.integers(3, 8),
+    middle=st.booleans(),
+    hood=st.sampled_from(["M", "N"]),
+    b_lo=st.integers(1, 9), b_w=st.integers(0, 12),
+    s_lo=st.integers(0, 9), s_w=st.integers(0, 12),
+)
+
+
+@settings(max_examples=12, deadline=None)
+@given(rule=_ltl_multistate, seed=seeds_,
+       topology=st.sampled_from(list(Topology)))
+def test_ltl_planes_match_dense_for_random_multistate_rules(
+        rule, seed, topology):
+    """Any C >= 3 LtL rule: the bit-plane decay stepper must equal the
+    dense byte path — random radii/state counts/interval positions reach
+    comparator and carry-chain corners the fixed oracle rules never do."""
+    from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+    from gameoflifewithactors_tpu.ops.packed_generations import (
+        pack_generations_for,
+        unpack_generations,
+    )
+    from gameoflifewithactors_tpu.ops.packed_ltl import multi_step_ltl_planes
+
+    grid = np.random.default_rng(seed).integers(
+        0, rule.states, size=(24, 64), dtype=np.uint8)
+    want = np.asarray(multi_step_ltl(
+        jnp.asarray(grid), 3, rule=rule, topology=topology))
+    got = np.asarray(unpack_generations(multi_step_ltl_planes(
+        pack_generations_for(jnp.asarray(grid), rule), 3, rule=rule,
+        topology=topology)))
+    np.testing.assert_array_equal(got, want, err_msg=rule.notation)
