@@ -5,7 +5,9 @@ radius-5 box rule), a von Neumann diamond variant, and a Golly C>=3
 multi-state rule whose failed survivors decay through dying states — and
 prints a population/backends summary. Every rule resolves its own best
 backend through the Engine's auto routing (bit-sliced packed for binary
-rules on TPU, the byte path for multi-state decay).
+rules on TPU; multi-state decay takes the bit-plane stack on CPU for
+diamonds and box radius <= 3 — the measured crossover — and the byte
+path otherwise).
 
     python examples/ltl_zoo.py --side 128 --gens 20
 """

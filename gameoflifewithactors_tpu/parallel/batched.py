@@ -49,6 +49,14 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, _SPEC)
 
 
+def batch_band_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement for the DP × band-kernel runner on a (nb, nx, ny > 1)
+    mesh: rows of every universe split into nx·ny full-width bands over
+    the flattened spatial axes (mirrors mesh.device_put_sharded_grid's
+    ``banded`` layout, batch axis in front)."""
+    return NamedSharding(mesh, P(BATCH_AXIS, (ROW_AXIS, COL_AXIS), None))
+
+
 def make_multi_step_packed_batched(
     mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
     donate: bool = False,
@@ -76,14 +84,17 @@ def make_multi_step_pallas_batched(
     interpret: Optional[bool] = None,
     donate: bool = False,
 ) -> Callable:
-    """The DP × native-kernel corner of the parallelism matrix: a (nb, nx,
-    1) mesh where every device advances its universes' full-width row bands
-    through the Mosaic slab kernel (parallel/sharded.py
-    make_multi_step_pallas has the band rationale and the SMEM edge-code
-    DEAD closure; the same restrictions apply). One depth-g ppermute per
-    side per chunk carries ALL local universes (halo.exchange_rows_stack);
-    each universe then runs its own kernel call — a static loop, not vmap,
-    because vmapping a manual-DMA pallas_call is unsupported territory.
+    """The DP × native-kernel corner of the parallelism matrix: a
+    (nb, nx, ny) mesh where every device advances its universes'
+    full-width row bands through the Mosaic slab kernel
+    (parallel/sharded.py make_multi_step_pallas has the band rationale
+    and the SMEM edge-code DEAD closure; the same restrictions apply).
+    A 2D spatial submesh flattens into nx·ny bands exactly like the
+    unbatched runner (``P('b', ('x', 'y'), None)``). One depth-g ppermute
+    per side per chunk carries ALL local universes
+    (halo.exchange_rows_stack); each universe then runs its own kernel
+    call — a static loop, not vmap, because vmapping a manual-DMA
+    pallas_call is unsupported territory.
 
     Returns jitted ``(grids, chunks) -> grids`` over a (B, H, W/32) packed
     batch advancing ``chunks * g`` generations.
@@ -91,15 +102,13 @@ def make_multi_step_pallas_batched(
     from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
     from .halo import band_edge_code, exchange_rows_stack
 
-    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
-    if ny != 1:
-        raise ValueError(
-            f"make_multi_step_pallas_batched needs an (nb, nx, 1) row-band "
-            f"mesh (got ny={ny}); use make_multi_step_packed_batched")
+    from .mesh import band_axis
+
+    axis, nbands = band_axis(mesh)
     g = int(gens_per_exchange)
     if interpret is None:
         interpret = default_interpret()
-    spec = P(BATCH_AXIS, ROW_AXIS, None)
+    spec = P(BATCH_AXIS, axis, None)
 
     dead = topology is Topology.DEAD
 
@@ -108,12 +117,12 @@ def make_multi_step_pallas_batched(
             raise ValueError(
                 f"gens_per_exchange={g} exceeds the per-device band height "
                 f"{tiles.shape[1]}")
-        ext = exchange_rows_stack(tiles, nx, topology, depth=g)
+        ext = exchange_rows_stack(tiles, nbands, topology, axis=axis, depth=g)
         call = make_pallas_slab_step(
             rule, topology, ext.shape[1:], gens=g, block_rows=block_rows,
             interpret=interpret, dead_band=dead)
         if dead:
-            edge = band_edge_code(nx)
+            edge = band_edge_code(nbands, axis=axis)
             out = [call(ext[i], edge)[g:-g] for i in range(ext.shape[0])]
         else:
             out = [call(ext[i])[g:-g] for i in range(ext.shape[0])]

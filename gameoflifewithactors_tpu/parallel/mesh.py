@@ -143,6 +143,18 @@ def make_mesh(
     return Mesh(arr, (ROW_AXIS, COL_AXIS))
 
 
+def band_axis(mesh: Mesh):
+    """The band runners' logical band axis: ROW_AXIS on an (.., nx, 1)
+    mesh, the flattened (ROW_AXIS, COL_AXIS) tuple on a 2D spatial
+    (sub)mesh — nx·ny full-width bands in x-major device order. The ONE
+    definition shared by the sharded and batched band runners and their
+    edge-code/exchange calls, so the flattening convention cannot drift.
+    Returns (axis, n_bands)."""
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    axis = ROW_AXIS if ny == 1 else (ROW_AXIS, COL_AXIS)
+    return axis, nx * ny
+
+
 def grid_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding that tiles a (H, W) or (H, W/32) grid 2D over the mesh."""
     return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))

@@ -37,18 +37,9 @@ from .halo import (
     exchange_rows,
     exchange_rows_stack,
 )
-from .mesh import COL_AXIS, ROW_AXIS
+from .mesh import COL_AXIS, ROW_AXIS, band_axis as _band_axis
 
 _SPEC = P(ROW_AXIS, COL_AXIS)
-
-
-def _band_axis(mesh: Mesh):
-    """The band runners' logical band axis: ROW_AXIS on an (nx, 1) mesh,
-    the flattened (ROW_AXIS, COL_AXIS) tuple on a 2D mesh — nx·ny
-    full-width bands in x-major device order. Returns (axis, n_bands)."""
-    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
-    axis = ROW_AXIS if ny == 1 else (ROW_AXIS, COL_AXIS)
-    return axis, nx * ny
 
 
 def _dense_ext_step(ext: jax.Array, rule: Rule) -> jax.Array:

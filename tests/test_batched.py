@@ -59,28 +59,26 @@ def test_batch_mesh_validation():
 @pytest.mark.parametrize("mesh_shape,B,H,g", [
     ((2, 4, 1), 4, 32, 3),   # 2 universes/device, 8-row bands
     ((4, 2, 1), 4, 64, 8),   # 1 universe/device, 32-row bands
+    ((2, 2, 2), 4, 32, 3),   # 2D spatial submesh: flattened into 4 bands
 ])
 def test_batched_pallas_band_bit_identity(mesh_shape, B, H, g, topology):
     """DP x row-band native-kernel composition (interpret mode): every
     universe must match its own single-device packed evolution — DEAD
-    exercises the SMEM edge-code exterior re-zero through the DP stack."""
+    exercises the SMEM edge-code exterior re-zero through the DP stack;
+    (nb, nx, ny > 1) meshes flatten the spatial axes into nx*ny bands."""
     rng = np.random.default_rng(31)
     grids = rng.integers(0, 2, size=(B, H, 64), dtype=np.uint8)
     packed = jnp.stack([bitpack.pack(jnp.asarray(u)) for u in grids])
 
     mesh = batched.make_batch_mesh(mesh_shape)
+    sharding = (batched.batch_band_sharding(mesh) if mesh_shape[2] > 1
+                else batched.batch_sharding(mesh))
     run = batched.make_multi_step_pallas_batched(
         mesh, CONWAY, topology=topology, gens_per_exchange=g, interpret=True)
-    out = run(jax.device_put(packed, batched.batch_sharding(mesh)), 2)
+    out = run(jax.device_put(packed, sharding), 2)
     for i in range(B):
         want = multi_step_packed(packed[i], 2 * g, rule=CONWAY,
                                  topology=topology)
         np.testing.assert_array_equal(
             np.asarray(out[i]), np.asarray(want),
             err_msg=f"universe {i} diverged on mesh {mesh_shape}")
-
-
-def test_batched_pallas_band_rejections():
-    with pytest.raises(ValueError, match=r"\(nb, nx, 1\) row-band"):
-        batched.make_multi_step_pallas_batched(
-            batched.make_batch_mesh((2, 2, 2)), CONWAY)
