@@ -265,3 +265,32 @@ def test_cli_mesh_bands_end_to_end(capsys):
                    "--mesh", "bands", "--render", "final", "--population"])
     assert rc == 0
     assert "gen 4" in capsys.readouterr().out
+
+
+def test_multistate_ltl_checkpoint_across_layouts(tmp_path):
+    """A C >= 3 LtL universe saved from the sharded banded plane engine
+    reloads bit-exactly into every other serving layout (dense
+    single-device, sparse planes, packed planes) and keeps evolving
+    identically — the checkpoint story composed with both round-4
+    features."""
+    import jax
+
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 4, size=(64, 128), dtype=np.uint8)
+    spec = "R2,C4,M1,S3..8,B5..9"
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    src = Engine(g, spec, mesh=m, backend="packed")   # sharded planes
+    src.step(9)
+    path = ckpt.save(src, tmp_path / "mltl.npz")
+    want = src.snapshot()
+    src.step(5)
+    for backend in ("dense", "packed", "sparse"):
+        back = ckpt.load_engine(path, backend=backend)
+        np.testing.assert_array_equal(back.snapshot(), want)
+        back.step(5)
+        np.testing.assert_array_equal(back.snapshot(), src.snapshot(),
+                                      err_msg=backend)
