@@ -351,6 +351,25 @@ def step_ltl_planes(plist, rule: LtLRule, topology: Topology):
     return transition_planes(plist, alive, born_p, keep_p, rule.states)
 
 
+def step_ltl_planes_slab(plist, rule: LtLRule, topology: Topology):
+    """b (L, Wp) planes -> b (L - 2r, Wp): one generation of a multi-state
+    slab (vertical DEAD closure consuming r halo rows per side, horizontal
+    closure ``topology`` across the slab's own width) — the radius-r
+    plane-stack face of packed.step_packed_slab, serving the chunked
+    sparse windows (ops/sparse.py) like its binary twin."""
+    from .packed_generations import _alive_of, transition_planes
+
+    _require_multistate(rule)
+    r = rule.radius
+    alive = _alive_of(plist)
+    counts = [c[r:-r] for c in neighborhood_counts_packed(
+        alive, rule, Topology.DEAD, topology)]
+    interior = tuple(p[r:-r] for p in plist)
+    born_p, keep_p = _interval_masks(alive[r:-r], counts, rule)
+    return transition_planes(interior, alive[r:-r], born_p, keep_p,
+                             rule.states)
+
+
 def step_ltl_planes_ext(ext_list, rule: LtLRule):
     """One generation from b halo-extended (h + 2r, wp + 2) planes ->
     interior (h, wp) plane tuple — r halo rows and one halo word per side

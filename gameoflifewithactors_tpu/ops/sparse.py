@@ -46,40 +46,132 @@ from .packed import step_packed_ext
 from .stencil import Topology
 
 
+def _step_fns(rule, ndim: int):
+    """The ``(ext_step, slab_step)`` pair for a rule/layout — every
+    family's two stepper variants selected in ONE place, so the per-gen
+    (:func:`_step_window`) and chunked (:func:`_step_window_chunk`) paths
+    cannot drift as layouts are added. ``ext`` consumes fixed (r, rw)
+    halos and returns the interior; ``slab`` shrinks 2r rows with
+    vertical-DEAD closure (the chunk loop's building block)."""
+    from ..models.ltl import LtLRule
+
+    def stacked(fn, *extra):
+        return lambda w: jnp.stack(fn(
+            tuple(w[i] for i in range(w.shape[0])), rule, *extra))
+
+    if isinstance(rule, LtLRule):
+        if ndim == 3:
+            from .packed_ltl import step_ltl_planes_ext, step_ltl_planes_slab
+
+            return (stacked(step_ltl_planes_ext),
+                    stacked(step_ltl_planes_slab, Topology.DEAD))
+        from .packed_ltl import step_ltl_packed_ext, step_ltl_packed_slab
+
+        return (lambda w: step_ltl_packed_ext(w, rule),
+                lambda w: step_ltl_packed_slab(w, rule, Topology.DEAD))
+    if ndim == 2:
+        from .packed import step_packed_slab
+
+        return (lambda w: step_packed_ext(w, rule),
+                lambda w: step_packed_slab(w, rule, Topology.DEAD))
+    from .packed_generations import step_planes_ext, step_planes_slab
+
+    return (stacked(step_planes_ext),
+            stacked(step_planes_slab, Topology.DEAD))
+
+
 def _step_window(window, rule):
     """One generation of a halo-extended window in any layout: a
     (tr+2r, tw+2) packed bitboard (binary 3x3 or radius-r LtL), a
     (b, tr+2, tw+2) Generations bit-plane stack, or a (b, tr+2r, tw+2)
     multi-state LtL plane stack (leading plane axis)."""
-    from ..models.ltl import LtLRule
-
-    if isinstance(rule, LtLRule):
-        if window.ndim == 3:
-            from .packed_ltl import step_ltl_planes_ext
-
-            return jnp.stack(step_ltl_planes_ext(
-                tuple(window[i] for i in range(window.shape[0])), rule))
-        from .packed_ltl import step_ltl_packed_ext
-
-        return step_ltl_packed_ext(window, rule)
-    if window.ndim == 2:
-        return step_packed_ext(window, rule)
-    from .packed_generations import step_planes_ext
-
-    return jnp.stack(step_planes_ext(
-        tuple(window[i] for i in range(window.shape[0])), rule))
+    return _step_fns(rule, window.ndim)[0](window)
 
 
-def _wake_dilation(rule, tile_rows: int, tile_words: int) -> Tuple[int, int]:
+def _wake_dilation(rule, tile_rows: int, tile_words: int,
+                   gens: int = 1) -> Tuple[int, int]:
     """Wake radius in TILE units, (dy, dx): a rule's influence travels r
-    cells per generation, so a tile must wake when anything within
-    ceil(r / tile_extent) tile rings changed. The ONE definition shared by
-    the on-device candidate dilation and the host capacity estimator —
-    they must agree or adaptive escalation can under-provision."""
+    cells per generation, so over a ``gens``-generation chunk a tile must
+    wake when anything within ceil(r·gens / tile_extent) tile rings
+    changed. The ONE definition shared by the on-device candidate
+    dilation and the host capacity estimator — they must agree or
+    adaptive escalation can under-provision."""
     r, _ = _rule_halo(rule)
     from . import bitpack
 
-    return -(-r // tile_rows), -(-r // (tile_words * bitpack.WORD))
+    hr = r * gens
+    return -(-hr // tile_rows), -(-hr // (tile_words * bitpack.WORD))
+
+
+def max_chunk_gens(rule) -> int:
+    """The deepest legal temporal chunk for a rule: g·r <= 32 keeps the
+    horizontal DEAD-closure creep inside the single halo WORD (the
+    communication-avoiding runner's bound); capped at 8 — beyond that the
+    extra halo rows outgrow the scan win."""
+    r, _ = _rule_halo(rule)
+    return max(1, min(8, 32 // r))
+
+
+def _step_window_chunk(window, rule, gens: int, exterior=None):
+    """Advance a halo-extended window ``gens`` generations entirely
+    locally: the (r·gens)-row vertical halos are consumed slab-style
+    (2r rows per generation), and the horizontal DEAD-closure corruption
+    creeps r cells/generation into the halo word, absorbed for
+    r·gens <= 32 — the communication-avoiding trick applied per window.
+
+    Input (lead, tr + 2·r·gens, tw + 2rw); returns ``(interior,
+    changed)``: the exact (lead, tr, tw) tile interior after ``gens``
+    generations, and a scalar bool that is True if the interior changed
+    at ANY generation of the chunk — NOT merely between the endpoints.
+    The distinction is soundness, not taste: a period-p oscillator with
+    p | gens is endpoint-identical while emitting changing influence
+    every generation, so endpoint comparison would put it (and then,
+    wrongly, its neighbors) to sleep. The tile interior is exact at
+    every intermediate step (the remaining slab always covers it, and
+    horizontal creep stays inside the halo word), so the per-step
+    comparison is exact too.
+
+    ``exterior`` (global DEAD topology): ``(row0, col0, ring, H, rw,
+    Wp)`` — the window's origin in padded coordinates plus the grid
+    bounds. Window cells beyond the global grid are PERMANENTLY dead,
+    but the free slab evolution would birth cells there from the ring
+    zeros and feed them back into the interior from the 2nd in-slab
+    generation on (the exact failure mode the band kernels'
+    _zero_band_exterior guards), so they are re-zeroed before every
+    generation. TORUS needs no mask — the ring holds real wrapped data
+    whose free evolution is exact."""
+    r, _ = _rule_halo(rule)
+    hr = r * gens
+    step1 = _step_fns(rule, window.ndim)[1]
+
+    def interior(w, k):
+        off = hr - k * r            # halo rows remaining per side
+        return w[..., off:w.shape[-2] - off, 1:-1]
+
+    def zero_exterior(w, k):
+        row0, col0, ring, H, rw, Wp = exterior
+        rows = jax.lax.broadcasted_iota(jnp.int32, w.shape, w.ndim - 2)
+        cols = jax.lax.broadcasted_iota(jnp.int32, w.shape, w.ndim - 1)
+        # padded coordinates of slab cell (row, col) after k shrinks
+        grow = row0 + k * r + rows
+        gcol = col0 + cols
+        ext = ((grow < ring) | (grow >= ring + H)
+               | (gcol < rw) | (gcol >= rw + Wp))
+        return jnp.where(ext, jnp.uint32(0), w)
+
+    prev = interior(window, 0)
+    changed = jnp.zeros((), dtype=bool)
+    for k in range(1, gens + 1):
+        if exterior is not None and k >= 2:
+            # before the FIRST step the exterior is already zero (the
+            # ring is never scattered into), so masking starts when the
+            # free evolution could first have birthed exterior cells
+            window = zero_exterior(window, k - 1)
+        window = step1(window)
+        cur = interior(window, k)
+        changed = changed | (cur != prev).any()
+        prev = cur
+    return prev, changed
 
 
 def _rule_halo(rule) -> Tuple[int, int]:
@@ -218,13 +310,23 @@ def _build_sparse_step(
     tile_words: int,
     capacity: int,
     topology: Topology = Topology.DEAD,
+    gens: int = 1,
+    ring_rows: int = 0,
 ):
     """Build the jitted ``sparse_many`` runner for this config.
 
     DEAD: the zero ring *is* the boundary. TORUS: the ring is refreshed
-    with wrapped interior edges each generation (same whole-word halo
+    with wrapped interior edges each chunk (same whole-word halo
     mechanism as the sharded path's ppermute strips) and tile-activity
     dilation wraps, so seam-crossing ships work.
+
+    ``gens`` > 1 is temporal chunking: each gathered window carries
+    (r·gens)-row halos and advances gens generations locally
+    (:func:`_step_window_chunk`) before one scatter — amortizing the
+    per-iteration activity-map scan (the measured dominant cost) by
+    gens×. ``ring_rows`` is the padded buffer's ring depth (>= r·gens;
+    the engine sizes it once for its chunk_gens, and the gens=1
+    remainder program gathers offset inside the same buffer).
 
     SparseEngineState.step orchestrates this with the capacity-independent
     :func:`_build_dense_once` fallback. The common all-sparse case runs
@@ -244,35 +346,47 @@ def _build_sparse_step(
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
     r, rw = _rule_halo(rule)
+    hr = r * gens                       # this program's window halo rows
+    ring = ring_rows or hr              # the buffer's ring depth
+    off = ring - hr                     # window gather offset inside it
 
     def gather_window(padded, ty, tx):
-        # window = tile + the rule's (r, rw) halo ring; the padded grid's
-        # matching ring offset makes this exact (leading plane axes, if
-        # any, are taken whole)
+        # window = tile + (r·gens rows, rw words) of halo; the padded
+        # ring's matching offset makes this exact (leading plane axes,
+        # if any, are taken whole)
         return jax.lax.dynamic_slice(
             padded,
-            (0,) * len(lead) + (ty * tile_rows, tx * tile_words),
-            lead + (tile_rows + 2 * r, tile_words + 2 * rw),
+            (0,) * len(lead) + (off + ty * tile_rows, tx * tile_words),
+            lead + (tile_rows + 2 * hr, tile_words + 2 * rw),
         )
 
     def sparse_gen(padded, candidates, n_cand):
         if wrap:
-            padded = _refresh_ring(padded, r, rw)
+            padded = _refresh_ring(padded, ring, rw)
         idx = jnp.nonzero(candidates.ravel(), size=capacity, fill_value=0)[0]
         valid = jnp.arange(capacity) < n_cand
         tys, txs = idx // ntx, idx % ntx
         windows = jax.vmap(lambda ty, tx: gather_window(padded, ty, tx))(tys, txs)
-        stepped = jax.vmap(lambda w: _step_window(w, rule))(windows)
-        olds = windows[..., r:-r, rw:-rw]
-        changed_any = jnp.logical_and(
-            (stepped != olds).any(axis=tuple(range(1, stepped.ndim))), valid)
+        if wrap or gens == 1:
+            # TORUS: the ring holds real wrapped data (free evolution is
+            # exact); a single generation never evolves the zero ring
+            step_one = lambda w, ty, tx: _step_window_chunk(w, rule, gens)
+        else:
+            # global DEAD: mask the window's beyond-the-grid cells dead
+            # before every in-slab generation (see _step_window_chunk)
+            step_one = lambda w, ty, tx: _step_window_chunk(
+                w, rule, gens,
+                exterior=(off + ty * tile_rows, tx * tile_words,
+                          ring, H, rw, Wp))
+        stepped, changed = jax.vmap(step_one)(windows, tys, txs)
+        changed_any = jnp.logical_and(changed, valid)
 
         # ONE batched scatter for all tiles (vs. a capacity-long serial
         # chain of dynamic_update_slice). Invalid (fill) slots alias tile 0
         # and must not touch state: they are routed out of bounds and
         # dropped; the remaining indices are distinct tiles, so
         # unique_indices is safe.
-        row0 = jnp.where(valid, tys * tile_rows + r, H + 2 * r)
+        row0 = jnp.where(valid, tys * tile_rows + ring, H + 2 * ring)
         col0 = jnp.where(valid, txs * tile_words + rw, Wp + 2 * rw)
         rows = row0[:, None, None] + jnp.arange(tile_rows)[None, :, None]
         cols = col0[:, None, None] + jnp.arange(tile_words)[None, None, :]
@@ -292,11 +406,11 @@ def _build_sparse_step(
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def sparse_many(padded, active, n):
-        """Run up to ``n`` generations on-device; stop early at the first
-        generation whose candidate set exceeds capacity. Returns
-        (padded, active, generations_actually_done)."""
+        """Run up to ``n`` CHUNKS (of ``gens`` generations) on-device;
+        stop early at the first chunk whose candidate set exceeds
+        capacity. Returns (padded, active, chunks_actually_done)."""
 
-        dy, dx = _wake_dilation(rule, tile_rows, tile_words)
+        dy, dx = _wake_dilation(rule, tile_rows, tile_words, gens)
 
         def carry_of(padded, active, i):
             cand = _dilate(active, wrap, dy=dy, dx=dx)
@@ -325,29 +439,35 @@ def _build_dense_once(
     tile_rows: int,
     tile_words: int,
     topology: Topology = Topology.DEAD,
+    ring_rows: int = 0,
 ):
     """One full-grid generation (the overflow fallback). Deliberately NOT
     keyed on capacity: an adaptive engine that escalates must not
-    re-compile this O(grid) step per capacity level."""
+    re-compile this O(grid) step per capacity level. ``ring_rows`` is the
+    buffer's ring depth (>= the rule's r; the chunked engine sizes its
+    ring for r·chunk_gens, and this per-generation step reads the inner
+    (r, rw) sub-ring of it)."""
     lead, (H, Wp) = shape[:-2], shape[-2:]
     nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
     wrap = topology is Topology.TORUS
     r, rw = _rule_halo(rule)
+    ring = ring_rows or r
 
     @partial(jax.jit, donate_argnums=(0,))
     def dense_once(padded):
         if wrap:
-            padded = _refresh_ring(padded, r, rw)
-        old = padded[..., r:-r, rw:-rw]
+            padded = _refresh_ring(padded, ring, rw)
+        old = padded[..., ring:-ring, rw:-rw]
         # step the interior against the ring (zero = DEAD boundary;
-        # wrapped copies = torus)
-        new = _step_window(padded, rule)
+        # wrapped copies = torus), reading the inner (r, rw) sub-ring
+        sub = padded[..., ring - r:padded.shape[-2] - (ring - r), :]
+        new = _step_window(sub, rule)
         tiles_old = old.reshape(*lead, nty, tile_rows, ntx, tile_words)
         tiles_new = new.reshape(*lead, nty, tile_rows, ntx, tile_words)
         changed = (tiles_old != tiles_new).any(
             axis=tuple(range(len(lead))) + (-3, -1))
         padded = jax.lax.dynamic_update_slice(
-            padded, new, (0,) * len(lead) + (r, rw))
+            padded, new, (0,) * len(lead) + (ring, rw))
         return padded, changed
 
     return dense_once
@@ -365,6 +485,7 @@ class SparseEngineState:
         tile_words: int | None = None,
         capacity: int | None = None,
         topology: Topology = Topology.DEAD,
+        chunk_gens: int | None = None,
     ):
         H, Wp = packed.shape[-2:]
         if tile_rows is None and tile_words is None:
@@ -372,6 +493,30 @@ class SparseEngineState:
         tile_rows = tile_rows or DEFAULT_TILE_ROWS
         tile_words = tile_words or DEFAULT_TILE_WORDS
         _tile_grid_shape(H, Wp, tile_rows, tile_words)  # validate
+        r0, _ = _rule_halo(rule)
+        if chunk_gens is None:
+            # Temporal chunking (windows carry (r·g)-row halos and advance
+            # g generations per gather, amortizing the activity-map scan
+            # g-fold) DEFAULTS OFF: the scan dominates a per-generation
+            # step (measured ~100% of a 32768² CPU generation), but under
+            # XLA's CPU lowering the unrolled shrinking-slab window chain
+            # loses more than the scan win (measured 5x slower at g=8 —
+            # the same non-fusion that makes the communication-avoiding
+            # sharded runner CPU-slow). Built for the TPU, where the scan
+            # was the measured 26 ms/gen bottleneck of config #5
+            # (pre-auto-tiling); scripts/config5_sparse.py --chunk-gens
+            # A/Bs it on chip before any default flips.
+            chunk_gens = 1
+        if chunk_gens < 1 or chunk_gens * r0 > 32:
+            raise ValueError(
+                f"chunk_gens must satisfy 1 <= g and g*radius <= 32 (the "
+                f"halo word bounds horizontal creep), got g={chunk_gens} "
+                f"for radius {r0}")
+        if chunk_gens * r0 > H:
+            raise ValueError(
+                f"chunk_gens={chunk_gens} needs a ring of {chunk_gens * r0} "
+                f"rows > the grid's {H}; use a smaller chunk")
+        self.chunk_gens = chunk_gens
         # capacity policy: an explicit value is FIXED (overflow -> one dense
         # full-grid generation, as documented); None is adaptive — start
         # near the seeded activity and double on overflow (each escalation
@@ -402,23 +547,24 @@ class SparseEngineState:
         self.tile_words = tile_words
         self.topology = topology
         self.shape = tuple(packed.shape)
-        self._halo = _rule_halo(rule)       # (rows, words) ring depth
-        r, rw = self._halo
-        self.padded = _pad_ring(packed, r, rw)
+        r, rw = _rule_halo(rule)
+        self._halo = (r * chunk_gens, rw)   # (rows, words) ring depth
+        ring, _ = self._halo
+        self.padded = _pad_ring(packed, ring, rw)
         self.active = initial_activity(self.padded, tile_rows, tile_words,
-                                       r, rw)
+                                       ring, rw)
         nty, ntx = _tile_grid_shape(H, Wp, tile_rows, tile_words)
         self._cap_ceiling = min(_MAX_ADAPTIVE_CAPACITY,
                                 1 << (nty * ntx - 1).bit_length())
         if self._adaptive:
             # one dilation factor's worth of headroom over the seeded tiles
-            # covers the first generations ((2dy+1)(2dx+1) = 9 for 3x3
-            # rules, more when a radius-r rule crosses several tile rings);
+            # covers the first chunk ((2dy+1)(2dx+1) = 9 for unchunked 3x3
+            # rules, more when r·chunk_gens crosses several tile rings);
             # pow2 keeps the lru-cached compile set small across
             # escalations; never batch more windows than tiles exist
             # (dense seeds would otherwise pay full compute on fill slots
             # forever)
-            dy, dx = _wake_dilation(rule, tile_rows, tile_words)
+            dy, dx = _wake_dilation(rule, tile_rows, tile_words, chunk_gens)
             factor = (2 * dy + 1) * (2 * dx + 1)
             want = max(32, factor * int(jnp.sum(self.active)))
             capacity = min(1 << (want - 1).bit_length(), self._cap_ceiling)
@@ -426,48 +572,68 @@ class SparseEngineState:
 
     def _set_capacity(self, capacity: int) -> None:
         self.capacity = capacity
+        ring, _ = self._halo
         self._sparse_many = _build_sparse_step(
             self.rule, self.shape, self.tile_rows, self.tile_words,
-            capacity, self.topology
+            capacity, self.topology, gens=self.chunk_gens, ring_rows=ring
         )
+        # the n % chunk_gens remainder program: same buffer, 1-gen windows
+        self._sparse_many_1 = (
+            self._sparse_many if self.chunk_gens == 1 else _build_sparse_step(
+                self.rule, self.shape, self.tile_rows, self.tile_words,
+                capacity, self.topology, gens=1, ring_rows=ring))
         self._dense_once = _build_dense_once(
             self.rule, self.shape, self.tile_rows, self.tile_words,
-            self.topology
+            self.topology, ring_rows=ring
         )
 
     def step(self, n: int = 1) -> None:
         """Advance ``n`` generations: the on-device while_loop runs sparse
-        generations until done or a capacity overflow. Adaptive capacity
+        CHUNKS (chunk_gens generations per gathered window; the n %
+        chunk_gens remainder takes the 1-generation program over the same
+        ring buffer) until done or a capacity overflow. Adaptive capacity
         (the default) handles overflow by doubling and retrying — the
         universe state is untouched (the loop's guard runs before the
-        over-capacity generation), so escalation costs one recompile, not
-        a correctness risk; at _MAX_ADAPTIVE_CAPACITY, and always for an
+        over-capacity chunk), so escalation costs one recompile, not a
+        correctness risk; at _MAX_ADAPTIVE_CAPACITY, and always for an
         explicit fixed capacity, overflow falls back to one dense
         full-grid generation and resumes. The host reads one scalar
-        (generations completed) per dispatch — the price of keeping the
-        common path copy-free; all-sparse runs cost exactly one dispatch
-        + one scalar fetch regardless of ``n``."""
+        (chunks completed) per dispatch — the price of keeping the common
+        path copy-free; all-sparse runs cost at most two dispatches (bulk
+        + remainder) + scalar fetches regardless of ``n``."""
+        g = self.chunk_gens
         remaining = int(n)
         while remaining > 0:
-            self.padded, self.active, done = self._sparse_many(
-                self.padded, self.active, remaining)
-            remaining -= int(done)
-            if remaining > 0:
-                if self._adaptive and self.capacity < self._cap_ceiling:
-                    # one cheap map reduction tells us the needed capacity:
-                    # jump straight there (one recompile) instead of
-                    # doubling through several zero-progress dispatches
-                    dy, dx = _wake_dilation(self.rule, self.tile_rows,
-                                            self.tile_words)
-                    need = int(jnp.sum(_dilate(
-                        self.active, self.topology is Topology.TORUS,
-                        dy=dy, dx=dx)))
-                    want = max(2 * self.capacity, need)
-                    self._set_capacity(
-                        min(1 << (want - 1).bit_length(), self._cap_ceiling))
-                    continue
-                self.padded, self.active = self._dense_once(self.padded)
-                remaining -= 1
+            chunks = remaining // g
+            if chunks:
+                self.padded, self.active, done = self._sparse_many(
+                    self.padded, self.active, chunks)
+                remaining -= int(done) * g
+                if int(done) == chunks:
+                    continue            # bulk complete; loop for remainder
+            else:
+                self.padded, self.active, done = self._sparse_many_1(
+                    self.padded, self.active, remaining)
+                remaining -= int(done)
+                if remaining == 0:
+                    return
+            # overflow: the next chunk/generation exceeds capacity
+            if self._adaptive and self.capacity < self._cap_ceiling:
+                # one cheap map reduction tells us the needed capacity:
+                # jump straight there (one recompile) instead of
+                # doubling through several zero-progress dispatches
+                dy, dx = _wake_dilation(self.rule, self.tile_rows,
+                                        self.tile_words,
+                                        g if remaining >= g else 1)
+                need = int(jnp.sum(_dilate(
+                    self.active, self.topology is Topology.TORUS,
+                    dy=dy, dx=dx)))
+                want = max(2 * self.capacity, need)
+                self._set_capacity(
+                    min(1 << (want - 1).bit_length(), self._cap_ceiling))
+                continue
+            self.padded, self.active = self._dense_once(self.padded)
+            remaining -= 1
 
     def reseed(self, packed: jax.Array) -> "SparseEngineState":
         """A fresh state over ``packed`` with this state's configuration,
@@ -478,6 +644,7 @@ class SparseEngineState:
             tile_rows=self.tile_rows, tile_words=self.tile_words,
             capacity=None if self._adaptive else self.capacity,
             topology=self.topology,
+            chunk_gens=self.chunk_gens,
         )
 
     @property

@@ -38,6 +38,15 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument("--capacity", type=int, default=None,
                     help="active-tile capacity (default: sparse engine's)")
+    ap.add_argument("--chunk-gens", type=int, default=None, metavar="G",
+                    help="temporal chunking depth for the headline run "
+                         "(default: the engine's, currently 1)")
+    ap.add_argument("--chunk-ab", action="store_true",
+                    help="after the headline run, re-run at the rule's max "
+                         "chunk depth and report both rates — the on-chip "
+                         "A/B that decides whether chunking's scan "
+                         "amortization beats its extra window work on TPU "
+                         "(on CPU it measured 5x slower)")
     ap.add_argument("--out", default=None, metavar="PATH")
     args = ap.parse_args()
 
@@ -63,9 +72,12 @@ def main() -> None:
     t0 = time.perf_counter()
     grid = seeds_lib.seeded_packed((side, side), "gosper_gun",
                                    top=side // 2, left_word=words // 2)
-    state = SparseEngineState(
-        jnp.asarray(grid), CONWAY,
-        **({"capacity": args.capacity} if args.capacity is not None else {}))
+    opts = {}
+    if args.capacity is not None:
+        opts["capacity"] = args.capacity
+    if args.chunk_gens is not None:
+        opts["chunk_gens"] = args.chunk_gens
+    state = SparseEngineState(jnp.asarray(grid), CONWAY, **opts)
     del grid
     print(json.dumps({"phase": "seeded", "grid": [side, side],
                       "packed_mb": round(side * words * 4 / 2**20, 1),
@@ -78,7 +90,9 @@ def main() -> None:
         return int(jnp.sum(state.padded.astype(jnp.uint32))) & 0xFFFF
 
     t0 = time.perf_counter()
-    state.step(4)  # compile + warm
+    # warm past one full chunk so the bulk chunked program (not just the
+    # remainder program) compiles OUTSIDE the timed repetitions
+    state.step(max(4, 2 * state.chunk_gens))
     sync()
     print(json.dumps({"phase": "warm", "compile_s": round(time.perf_counter() - t0, 2),
                       "active_tiles": state.active_tiles()}), flush=True)
@@ -100,11 +114,35 @@ def main() -> None:
         "active_tiles": state.active_tiles(),
         "total_tiles": (side // state.tile_rows) * (words // state.tile_words),
         "capacity": state.capacity,
+        "chunk_gens": state.chunk_gens,
         "population": pop,
         "generations_run": gens_done,
         "grid_bytes": side * words * 4,
         "platform": platform,
     }
+    if args.chunk_ab:
+        from gameoflifewithactors_tpu.ops.sparse import max_chunk_gens
+
+        g = max_chunk_gens(CONWAY)
+        del state  # free the headline run's 512 MB padded buffer first
+        cgrid = seeds_lib.seeded_packed(
+            (side, side), "gosper_gun", top=side // 2, left_word=words // 2)
+        cstate = SparseEngineState(jnp.asarray(cgrid), CONWAY, chunk_gens=g,
+                                   **({"capacity": args.capacity}
+                                      if args.capacity is not None else {}))
+        del cgrid
+        cstate.step(2 * g)  # compile + warm
+        int(jnp.sum(cstate.padded.astype(jnp.uint32)))
+        cbest = 0.0
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            cstate.step(args.gens)
+            int(jnp.sum(cstate.padded.astype(jnp.uint32)))
+            cbest = max(cbest, args.gens / (time.perf_counter() - t0))
+        summary["chunked_gens_per_sec"] = cbest
+        summary["chunked_chunk_gens"] = g
+        print(json.dumps({"phase": "chunk_ab", "chunk_gens": g,
+                          "gens_per_sec": cbest}), flush=True)
     print(json.dumps(summary), flush=True)
     if args.out:
         import platform as platform_mod

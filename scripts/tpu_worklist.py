@@ -48,7 +48,11 @@ WATCHDOG_S = float(os.environ.get("WORKLIST_WATCHDOG_S", "600"))
 # pairs) — observed 2026-07-31. Raising the GLOBAL watchdog instead would
 # stretch wedge detection on the other 11 items from 10 to 25 minutes
 # each, burning most of a healthy window on one wedge-everywhere cycle.
-_ITEM_WATCHDOG_S = {"pallas_autotune": 1500.0, "ltl_bosco": 1500.0}
+_ITEM_WATCHDOG_S = {"pallas_autotune": 1500.0, "ltl_bosco": 1500.0,
+                    # --chunk-ab roughly doubles the run (second 65536²
+                    # seed + compile + benchmark); a watchdog kill must
+                    # not discard the headline half with it
+                    "config5_sparse": 1500.0}
 
 
 def _watchdog_for(item: str) -> float:
@@ -814,7 +818,7 @@ def child_config5_sparse() -> dict:
     out_path = os.path.join(_REPO, "results", "config5_sparse_65536_tpu.json")
     r = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "config5_sparse.py"),
-         "--gens", "256", "--repeats", "2", "--out", out_path],
+         "--gens", "256", "--repeats", "2", "--chunk-ab", "--out", out_path],
         capture_output=True, text=True, timeout=WATCHDOG_S)
     line = next((ln for ln in reversed(r.stdout.strip().splitlines())
                  if ln.startswith("{")), None)

@@ -777,3 +777,150 @@ class TestShardedLtLSparse:
         rule = parse_any("R2,C4,M1,S3..8,B5..9")
         with pytest.raises(ValueError, match="bit-plane stack"):
             SparseEngineState(jnp.zeros((32, 4), jnp.uint32), rule)
+
+
+class TestTemporalChunkedSparse:
+    """Opt-in temporal chunking (chunk_gens > 1): windows carry
+    (r·g)-row halos and advance g generations per gather. Bit-identity
+    must hold through period-g oscillators (the per-step change
+    accumulation), global DEAD edges (the per-generation exterior
+    re-zero), torus seams, every rule family, and n % g remainders."""
+
+    @pytest.mark.parametrize("topology", [Topology.DEAD, Topology.TORUS])
+    def test_soup_bit_identity_with_remainder(self, topology):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.rules import CONWAY
+        from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+
+        rng = np.random.default_rng(3)
+        grid = np.zeros((256, 256), np.uint8)
+        grid[:40, :] = rng.integers(0, 2, size=(40, 256))  # touches the edge
+        p = jnp.asarray(bitpack.pack(jnp.asarray(grid)))
+        st = SparseEngineState(p, CONWAY, topology=topology, chunk_gens=8)
+        st.step(27)                                 # 3 chunks + 3 remainder
+        want = multi_step_packed(p, 27, rule=CONWAY, topology=topology)
+        np.testing.assert_array_equal(np.asarray(st.packed), np.asarray(want))
+
+    def test_period_divides_chunk_oscillator_wakes_neighbors(self):
+        """A blinker has period 2 | chunk 8: endpoint comparison would
+        mark its tile unchanged and stop waking neighbors — the soundness
+        case for per-step change accumulation. Seed soup NEXT to a
+        blinker so the neighbors matter."""
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.rules import CONWAY
+        from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+
+        grid = np.zeros((128, 128), np.uint8)
+        grid[64:67, 64] = 1                         # vertical blinker
+        rng = np.random.default_rng(5)
+        grid[70:90, 60:80] = rng.integers(0, 2, size=(20, 20))
+        p = jnp.asarray(bitpack.pack(jnp.asarray(grid)))
+        st = SparseEngineState(p, CONWAY, topology=Topology.DEAD,
+                               chunk_gens=8, tile_rows=8, tile_words=1)
+        st.step(48)
+        want = multi_step_packed(p, 48, rule=CONWAY, topology=Topology.DEAD)
+        np.testing.assert_array_equal(np.asarray(st.packed), np.asarray(want))
+        # an isolated pure blinker's tiles still stay awake (they change
+        # every generation), but the far side of the map sleeps
+        assert 0 < st.active_tiles() < st.active.size
+
+    @pytest.mark.parametrize("spec,g", [
+        ("bosco", 6),                               # r=5: g*r = 30 <= 32
+        ("R2,C0,M0,S6..11,B6..9,NN", 8),            # diamond, g*r = 16
+        ("brain", 8),                               # Generations planes
+        ("R2,C4,M1,S3..8,B5..9", 8),                # C>=3 LtL planes
+    ])
+    def test_families_chunked_bit_identity(self, spec, g):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+
+        rule = parse_any(spec)
+        rng = np.random.default_rng(7)
+        grid = np.zeros((128, 128), np.uint8)
+        grid[40:80, 30:90] = rng.integers(
+            0, getattr(rule, "states", 2), size=(40, 60))
+        if getattr(rule, "states", 2) > 2:
+            from gameoflifewithactors_tpu.ops.generations import (
+                multi_step_generations,
+            )
+            from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+            from gameoflifewithactors_tpu.ops.packed_generations import (
+                pack_generations_for,
+                unpack_generations,
+            )
+            from gameoflifewithactors_tpu.models.ltl import LtLRule
+
+            dense_run = (multi_step_ltl if isinstance(rule, LtLRule)
+                         else multi_step_generations)
+            want = np.asarray(dense_run(
+                jnp.asarray(grid), 2 * g + 3, rule=rule,
+                topology=Topology.DEAD))
+            st = SparseEngineState(
+                pack_generations_for(jnp.asarray(grid), rule), rule,
+                topology=Topology.DEAD, chunk_gens=g)
+            st.step(2 * g + 3)
+            got = np.asarray(unpack_generations(st.packed))
+        else:
+            from gameoflifewithactors_tpu.ops.packed_ltl import (
+                multi_step_ltl_packed,
+            )
+
+            p = jnp.asarray(bitpack.pack(jnp.asarray(grid)))
+            want_p = multi_step_ltl_packed(p, 2 * g + 3, rule=rule,
+                                           topology=Topology.DEAD)
+            want = np.asarray(bitpack.unpack(want_p))
+            st = SparseEngineState(p, rule, topology=Topology.DEAD,
+                                   chunk_gens=g)
+            st.step(2 * g + 3)
+            got = np.asarray(bitpack.unpack(st.packed))
+        np.testing.assert_array_equal(got, want, err_msg=spec)
+
+    def test_chunk_validation(self):
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.generations import parse_any
+        from gameoflifewithactors_tpu.ops.sparse import max_chunk_gens
+
+        bosco = parse_any("bosco")
+        assert max_chunk_gens(bosco) == 6           # 32 // 5
+        p = jnp.zeros((64, 4), jnp.uint32)
+        with pytest.raises(ValueError, match="g\\*radius <= 32"):
+            SparseEngineState(p, bosco, chunk_gens=7)
+        with pytest.raises(ValueError, match="ring"):
+            SparseEngineState(jnp.zeros((16, 4), jnp.uint32),
+                              parse_any("bosco"), chunk_gens=6)  # 30 > 16
+
+    @pytest.mark.parametrize("topology", [Topology.DEAD, Topology.TORUS])
+    def test_chunked_overflow_and_escalation_paths(self, topology):
+        """Capacity overflow with chunk_gens > 1: the bulk/remainder/
+        dense-fallback interplay and _build_dense_once's sub-ring slicing
+        (ring > r) must stay exact. Fixed capacity 2 forces the dense
+        fallback; an adaptive engine under the same soup escalates —
+        both must match the dense reference bit-for-bit."""
+        import jax.numpy as jnp
+
+        from gameoflifewithactors_tpu.models.rules import CONWAY
+        from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+
+        rng = np.random.default_rng(13)
+        grid = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)  # hot soup
+        p = jnp.asarray(bitpack.pack(jnp.asarray(grid)))
+        want = multi_step_packed(p, 11, rule=CONWAY, topology=topology)
+
+        fixed = SparseEngineState(p, CONWAY, topology=topology,
+                                  chunk_gens=4, tile_rows=16, tile_words=1,
+                                  capacity=2)
+        fixed.step(11)                              # dense fallback, ring > r
+        np.testing.assert_array_equal(np.asarray(fixed.packed),
+                                      np.asarray(want))
+
+        adaptive = SparseEngineState(p, CONWAY, topology=topology,
+                                     chunk_gens=4, tile_rows=16, tile_words=1)
+        adaptive._set_capacity(2)                   # badly undersized start
+        adaptive.step(11)
+        np.testing.assert_array_equal(np.asarray(adaptive.packed),
+                                      np.asarray(want))
+        assert adaptive.capacity > 2                # escalated, not stuck
