@@ -132,7 +132,12 @@ def _persist_if_best(key: str, result: dict) -> None:
     # records that are both uncertifiable (e.g. both dirty-tree), the
     # best-of value ratchet still decides.
     prov = _provenance()
-    stamp = prov.head_stamp()
+    # embed the resolved backend's measured file set (which includes
+    # bench.py itself — the timing protocol) so the record self-describes;
+    # explicit_record_paths returns None for an unparseable metric, and the
+    # conservative superset is then NOT embedded (locking the coarse set
+    # into the record would defeat later precision improvements)
+    stamp = prov.head_stamp(paths=prov.explicit_record_paths(result))
     new_uncertifiable = stamp.get("commit_dirty") or not stamp.get("commit")
     prev_stale = prev is not None and prov.staleness(prev)["stale"]
     if (prev is None or (prev_stale and not new_uncertifiable)
@@ -169,7 +174,9 @@ def report() -> None:
         for key, rec in sorted(store.items()):
             if not isinstance(rec, dict):
                 continue
-            st = prov.staleness(rec)
+            # worklist store keys ARE item names: select the per-item
+            # measured path set for records that predate measured_paths
+            st = prov.staleness(rec, item=key if label == "worklist" else None)
             rows.append({
                 "source": label, "key": key,
                 "ok": rec.get("ok"),

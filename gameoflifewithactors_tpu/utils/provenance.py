@@ -6,6 +6,30 @@ that no longer exists. Every persisted record therefore carries the git
 commit of the tree it measured, and consumers call :func:`staleness` to
 learn whether the record's measured paths changed since that stamp.
 
+VERDICT.md round-4 Weak #1/#3: staleness precision. Records that named no
+backend fell back to the everything-changed superset, so CPU-side feature
+work (e.g. an ``ops/sparse.py`` edit) staled the binary Pallas kernel's
+identity evidence whose measured files were untouched. Three fixes here:
+
+- :data:`ITEM_PATHS` — the measured file set of every worklist item,
+  derived from the imports its child body actually exercises
+  (``scripts/tpu_worklist.py``); consumers pass ``item=`` so old records
+  without their own path list still get a precise set.
+- New records carry a ``measured_paths`` field stamped at capture time,
+  which :func:`staleness` prefers over any in-code map — the capture-time
+  truth survives later refactors of this module.
+- Timing-protocol files are part of the measured set (``bench.py`` for
+  bench records): an edit to the measurement protocol flags the records
+  it produced, not just kernel edits.
+
+Additionally, *comment-only* edits no longer stale: when git reports a
+measured ``.py`` file changed, :func:`staleness` compares the token stream
+(comments and blank lines dropped) at the stamp vs the working tree, and
+certifies the record fresh when the executable code is identical. This is
+what lets hot-path files carry freeze-notice comments (VERDICT r4 #8)
+without destroying the very evidence those notices protect. Docstrings are
+STRING tokens and still count as code — only ``#`` comments are exempt.
+
 Pure stdlib + ``git`` subprocess; degrades to "unknown provenance" (which
 consumers treat as stale) when git is unavailable or the repo is absent —
 evidence must never look *fresher* than it can be proven to be.
@@ -13,34 +37,91 @@ evidence must never look *fresher* than it can be proven to be.
 
 from __future__ import annotations
 
+import io
 import os
 import subprocess
+import tokenize
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_PKG = "gameoflifewithactors_tpu"
+# Transitively shared substrate: the jit/donation wrapper, the Topology /
+# shift plumbing, and the bit-packing layout feed every measured op.
+_CORE = [f"{_PKG}/ops/_jit.py", f"{_PKG}/ops/stencil.py", f"{_PKG}/ops/bitpack.py"]
+_RULES = f"{_PKG}/models/rules.py"          # B/S semantics (binary families)
+_GENS = f"{_PKG}/models/generations.py"     # parse_any + Generations semantics
+_LTL = f"{_PKG}/models/ltl.py"              # LtL rule semantics
+_MESHY = [f"{_PKG}/parallel/sharded.py", f"{_PKG}/parallel/halo.py",
+          f"{_PKG}/parallel/mesh.py"]
+
 # The measured code path per bench backend: if any of these files changed
 # after a record's commit stamp, the record describes a predecessor kernel
-# and must be flagged. Conservative supersets: transitively imported shared
-# helpers (_jit donation wrapper, stencil's Topology/rule plumbing, bitpack)
-# are in every set — a rewrite there changes every backend's measured code.
-_SHARED = ["gameoflifewithactors_tpu/ops/_jit.py",
-           "gameoflifewithactors_tpu/ops/stencil.py",
-           "gameoflifewithactors_tpu/ops/bitpack.py",
-           "gameoflifewithactors_tpu/models"]  # rule semantics feed every op
+# and must be flagged. bench.py is in every set because it IS the timing
+# protocol of the records that carry a "(backend, ...)" metric string
+# (VERDICT r4 Weak #3) — a sync/repetition edit there changes what the
+# number means as surely as a kernel edit does.
 BACKEND_PATHS = {
-    "pallas": ["gameoflifewithactors_tpu/ops/pallas_stencil.py",
-               "gameoflifewithactors_tpu/ops/packed.py", *_SHARED],
-    "packed": ["gameoflifewithactors_tpu/ops/packed.py",
-               "gameoflifewithactors_tpu/ops/packed_generations.py",
-               "gameoflifewithactors_tpu/ops/packed_ltl.py", *_SHARED],
-    "dense": ["gameoflifewithactors_tpu/ops/generations.py",
-              "gameoflifewithactors_tpu/ops/ltl.py", *_SHARED],
-    "sparse": ["gameoflifewithactors_tpu/ops/sparse.py",
-               "gameoflifewithactors_tpu/ops/packed.py", *_SHARED],
+    "pallas": [f"{_PKG}/ops/pallas_stencil.py", f"{_PKG}/ops/packed.py",
+               *_CORE, _RULES, "bench.py"],
+    "packed": [f"{_PKG}/ops/packed.py", f"{_PKG}/ops/packed_generations.py",
+               f"{_PKG}/ops/packed_ltl.py", *_CORE, _RULES, _GENS, _LTL,
+               "bench.py"],
+    "dense": [f"{_PKG}/ops/generations.py", f"{_PKG}/ops/ltl.py",
+              *_CORE, _RULES, _GENS, _LTL, "bench.py"],
+    "sparse": [f"{_PKG}/ops/sparse.py", f"{_PKG}/ops/packed.py",
+               *_CORE, _RULES, f"{_PKG}/models/seeds.py", "bench.py"],
 }
 # Fallback when the backend can't be parsed out of a record: everything.
-ALL_OPS_PATHS = ["gameoflifewithactors_tpu/ops", "gameoflifewithactors_tpu/parallel",
-                 "gameoflifewithactors_tpu/models"]
+ALL_OPS_PATHS = [f"{_PKG}/ops", f"{_PKG}/parallel", f"{_PKG}/models"]
+
+_PALLAS_BINARY = [f"{_PKG}/ops/pallas_stencil.py", f"{_PKG}/ops/packed.py",
+                  *_CORE, _RULES]
+# Measured file set per worklist item (scripts/tpu_worklist.py child
+# bodies): exactly the modules whose code the child's measurement
+# exercises, so unrelated CPU-side work stops staling on-chip evidence
+# (VERDICT r4 Weak #1). Sets of items whose results carry measured RATES
+# also include the worklist script itself (appended below) — the
+# children's timing protocol (_bench_rate, sync, gens sizing) lives
+# there, and the same protocol-edit rule that puts bench.py in
+# BACKEND_PATHS applies; the cost (an edit for one item stales all rate
+# items until recapture) is the price of file-granularity honesty. The
+# two pure-assertion items (_ASSERTION_ITEMS) are exempt: a bit-identity
+# or HLO-structure verdict embeds the cases it checked in the record
+# itself, and no timing-protocol edit can change an equality result.
+# Keep in sync with the child imports when adding items; new captures
+# embed this set as ``measured_paths`` so the record stays self-describing.
+ITEM_PATHS = {
+    "pallas_identity": [*_PALLAS_BINARY],
+    "pallas_autotune": [*_PALLAS_BINARY],
+    "pallas_band": [*_PALLAS_BINARY, *_MESHY],
+    "profile_trace": [*_PALLAS_BINARY, f"{_PKG}/utils/profiling.py"],
+    "bench_packed": [f"{_PKG}/ops/packed.py", *_CORE, _RULES, "bench.py"],
+    "ltl_bosco": [f"{_PKG}/ops/ltl.py", f"{_PKG}/ops/packed_ltl.py",
+                  *_CORE, _RULES, _GENS, _LTL],
+    "generations_brain": [f"{_PKG}/ops/generations.py",
+                          f"{_PKG}/ops/packed_generations.py",
+                          *_CORE, _RULES, _GENS],
+    "ltl_lowering": [f"{_PKG}/ops/ltl.py", *_CORE, _GENS, _LTL],
+    "pallas_generations": [f"{_PKG}/ops/pallas_stencil.py",
+                           f"{_PKG}/ops/packed_generations.py",
+                           *_CORE, _RULES, _GENS],
+    "ltl_pallas": [f"{_PKG}/ops/pallas_stencil.py", f"{_PKG}/ops/packed_ltl.py",
+                   *_CORE, _RULES, _GENS, _LTL, *_MESHY],
+    "ltl_planes": [f"{_PKG}/ops/packed_ltl.py", f"{_PKG}/ops/ltl.py",
+                   f"{_PKG}/ops/packed_generations.py",
+                   *_CORE, _RULES, _GENS, _LTL],
+    "sparse_tiled": [f"{_PKG}/ops/sparse.py", f"{_PKG}/ops/packed.py",
+                     *_CORE, _RULES, f"{_PKG}/models/seeds.py", *_MESHY],
+    "elementary": [f"{_PKG}/ops/elementary.py", *_CORE,
+                   f"{_PKG}/models/elementary.py"],
+    "config5_sparse": [f"{_PKG}/ops/sparse.py", f"{_PKG}/ops/packed.py",
+                       *_CORE, _RULES, f"{_PKG}/models/seeds.py",
+                       "scripts/config5_sparse.py"],
+}
+_ASSERTION_ITEMS = ("pallas_identity", "ltl_lowering")
+for _item, _paths in ITEM_PATHS.items():
+    if _item not in _ASSERTION_ITEMS:
+        _paths.append("scripts/tpu_worklist.py")
 
 
 def _git(*args: str, repo: str | None = None) -> str | None:
@@ -49,12 +130,13 @@ def _git(*args: str, repo: str | None = None) -> str | None:
                            capture_output=True, text=True, timeout=30)
     except (OSError, subprocess.TimeoutExpired):
         return None
-    return r.stdout.strip() if r.returncode == 0 else None
+    return r.stdout if r.returncode == 0 else None
 
 
 def git_head(repo: str | None = None) -> str | None:
     """Short hash of HEAD, or None when unknowable."""
-    return _git("rev-parse", "--short", "HEAD", repo=repo)
+    out = _git("rev-parse", "--short", "HEAD", repo=repo)
+    return out.strip() if out is not None else None
 
 
 def changed_since(commit: str, paths: list[str], repo: str | None = None) -> list[str] | None:
@@ -73,24 +155,118 @@ def changed_since(commit: str, paths: list[str], repo: str | None = None) -> lis
     return sorted(files)
 
 
+_EQUIV_CACHE: dict[tuple, bool] = {}
+
+
+def _code_tokens(src: str) -> list[tuple[int, str]] | None:
+    """Token stream with comments and non-logical newlines dropped; None
+    when the source doesn't tokenize (treat as not-comparable)."""
+    try:
+        return [(t.type, t.string)
+                for t in tokenize.generate_tokens(io.StringIO(src).readline)
+                if t.type not in (tokenize.COMMENT, tokenize.NL)]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+
+
+def code_equivalent(commit: str, path: str, repo: str | None = None) -> bool:
+    """True when ``path``'s executable code is identical between ``commit``
+    and the working tree — i.e. every difference git sees is a ``#`` comment
+    or blank line. Only certifies ``.py`` files; anything else (or a file
+    that fails to read/tokenize on either side) counts as really changed.
+
+    Memoized: the report loop asks the same question once per record
+    sharing a changed file, and each miss costs a ``git show`` subprocess
+    plus two tokenizations. The commit side is immutable; the working-tree
+    side is keyed by the file's (mtime_ns, size) so an edit mid-process
+    invalidates the entry instead of serving the pre-edit answer."""
+    try:
+        st = os.stat(os.path.join(repo or _REPO, path))
+        tree_key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        tree_key = None
+    key = (commit, path, repo, tree_key)
+    if key not in _EQUIV_CACHE:
+        _EQUIV_CACHE[key] = _code_equivalent_uncached(commit, path, repo)
+    return _EQUIV_CACHE[key]
+
+
+def _code_equivalent_uncached(commit: str, path: str, repo: str | None) -> bool:
+    if not path.endswith(".py"):
+        return False
+    old = _git("show", f"{commit}:{path}", repo=repo)
+    if old is None:
+        return False
+    try:
+        with open(os.path.join(repo or _REPO, path)) as f:
+            new = f.read()
+    except OSError:
+        return False
+    old_t, new_t = _code_tokens(old), _code_tokens(new)
+    return old_t is not None and old_t == new_t
+
+
+def explicit_record_paths(record: dict, item: str | None = None) -> list[str] | None:
+    """The measured file set a record can *specifically* claim, most
+    specific source first: its own capture-time ``measured_paths``, the
+    in-code per-item set, the metric-named backend's set. None when only
+    the conservative superset would apply — callers embedding a set into
+    a new record must not embed the superset (that would lock coarseness
+    into the record and defeat later precision fixes)."""
+    own = record.get("measured_paths")
+    if isinstance(own, list) and own:
+        return own
+    if item and item in ITEM_PATHS:
+        return ITEM_PATHS[item]
+    metric = record.get("metric", "")
+    if "(" in metric:  # "... (pallas, 50% soup, tpu)" names the resolved backend
+        backend = metric.rsplit("(", 1)[1].split(",")[0].strip()
+        if backend in BACKEND_PATHS:
+            return BACKEND_PATHS[backend]
+    return None
+
+
+def record_paths(record: dict, item: str | None = None) -> list[str]:
+    """Like :func:`explicit_record_paths` but falling back to the
+    conservative everything-superset for staleness checking."""
+    return explicit_record_paths(record, item=item) or ALL_OPS_PATHS
+
+
 def head_stamp(paths: list[str] | None = None, repo: str | None = None) -> dict:
     """Provenance stamp for a measurement taken NOW: ``{"commit": <head>}``,
     plus ``"commit_dirty": True`` when the measured paths have uncommitted
     edits (or dirtiness can't be determined) — a dirty-tree measurement ran
-    code that exists at no commit, so it must never get clean provenance."""
+    code that exists at no commit, so it must never get clean provenance.
+    When ``paths`` is given the stamp also embeds it as ``measured_paths``
+    so the record self-describes what it measured."""
     stamp: dict = {"commit": git_head(repo=repo)}
     dirty = _git("status", "--porcelain", "--", *(paths or ALL_OPS_PATHS), repo=repo)
-    if dirty is None or dirty:
+    if dirty is None:
         stamp["commit_dirty"] = True
+    elif dirty.strip():
+        # comment-only uncommitted edits (e.g. a freeze notice awaiting its
+        # commit) don't brand the capture dirty: the executable code IS the
+        # stamped commit's, provable via the same token comparison
+        # staleness() uses. Untracked files and non-.py edits fail the
+        # equivalence check and keep the dirty brand.
+        dirty_files = [ln[3:].strip() for ln in dirty.splitlines() if ln.strip()]
+        head = stamp["commit"]
+        if not head or not all(code_equivalent(head, f, repo=repo)
+                               for f in dirty_files):
+            stamp["commit_dirty"] = True
+    if paths:
+        stamp["measured_paths"] = list(paths)
     return stamp
 
 
-def staleness(record: dict, repo: str | None = None) -> dict:
+def staleness(record: dict, repo: str | None = None, item: str | None = None) -> dict:
     """Classify a persisted measurement record's provenance.
 
     Returns ``{"stale": bool, "reason": str}`` — ``stale`` is True when the
     record has no commit stamp, the stamp can't be checked, or the measured
-    backend's code paths changed since the stamp.
+    code (see :func:`record_paths`; ``item`` selects the per-worklist-item
+    set for records that predate ``measured_paths``) changed since the
+    stamp. Comment-only edits to measured ``.py`` files do not stale.
     """
     commit = record.get("commit")
     if not commit:
@@ -104,16 +280,17 @@ def staleness(record: dict, repo: str | None = None) -> dict:
         return {"stale": True,
                 "reason": f"commit stamp {commit} is approximate (backfilled), "
                           "cannot certify the measured tree"}
-    backend = None
-    metric = record.get("metric", "")
-    if "(" in metric:  # "... (pallas, 50% soup, tpu)" names the resolved backend
-        backend = metric.rsplit("(", 1)[1].split(",")[0].strip()
-    paths = BACKEND_PATHS.get(backend, ALL_OPS_PATHS)
+    paths = record_paths(record, item=item)
     changed = changed_since(commit, paths, repo=repo)
     if changed is None:
         return {"stale": True, "reason": f"cannot verify commit {commit} (git unavailable)"}
-    if changed:
+    really = [f for f in changed if not code_equivalent(commit, f, repo=repo)]
+    if really:
         return {"stale": True,
-                "reason": f"measured paths changed since {commit}: {', '.join(changed[:4])}"
-                          + (f" (+{len(changed) - 4} more)" if len(changed) > 4 else "")}
+                "reason": f"measured paths changed since {commit}: {', '.join(really[:4])}"
+                          + (f" (+{len(really) - 4} more)" if len(really) > 4 else "")}
+    if changed:
+        return {"stale": False,
+                "reason": f"measured code unchanged since {commit} "
+                          f"(comment-only edits: {', '.join(changed[:4])})"}
     return {"stale": False, "reason": f"measured paths unchanged since {commit}"}

@@ -41,11 +41,13 @@ try:
     d = json.load(open("results/tpu_worklist.json"))
 except Exception:
     d = {}
-def fresh(r):
+def fresh(k, r):
     if not r or not r.get("ok"):
         return False
-    return r.get("recorded_at", "") >= t0 or not prov.staleness(r)["stale"]
-print(",".join(k for k in items if not fresh(d.get(k))))
+    # item= selects the per-item measured path set for records that
+    # predate the measured_paths field (utils/provenance.py ITEM_PATHS)
+    return r.get("recorded_at", "") >= t0 or not prov.staleness(r, item=k)["stale"]
+print(",".join(k for k in items if not fresh(k, d.get(k))))
 EOF
 }
 

