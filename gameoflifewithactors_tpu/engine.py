@@ -735,8 +735,18 @@ class Engine:
                 except Exception as exc:
                     # lowering unavailable (or the byte counter refused,
                     # e.g. an unlisted dtype): the arithmetic model stands
-                    # in for 'auto'; 'measured' surfaces the cause below
+                    # in for 'auto'; 'measured' surfaces the cause below.
+                    # Warn (not silent — ADVICE r4): the HLO figure is the
+                    # advertised default, so a regression in the
+                    # measurement path must be visible to 'auto' callers,
+                    # not only on an explicit source='measured' probe.
+                    import warnings
+
                     self._halo_hlo_err = exc
+                    warnings.warn(
+                        "halo_bytes_per_gen: HLO measurement failed "
+                        f"({type(exc).__name__}: {exc}); serving the "
+                        "arithmetic model", RuntimeWarning, stacklevel=2)
             if self._halo_hlo is not None:
                 return self._halo_hlo
             if source == "measured":

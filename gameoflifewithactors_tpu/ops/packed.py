@@ -23,6 +23,13 @@ Two entry points:
   bit-identity test (SURVEY.md §5) meaningful.
 """
 
+# EVIDENCE FREEZE (VERDICT r4 #8): this file is a measured path of the
+# serving on-chip records (the pallas kernel imports its stencil math
+# from here) — see the matching notice in ops/pallas_stencil.py. Any
+# non-comment edit re-stales the 2.20e12 headline and the pallas_identity
+# record until recapture; comment-only edits are certified harmless by
+# utils/provenance.py's token comparison.
+
 from __future__ import annotations
 
 from typing import List, Sequence, Tuple

@@ -140,13 +140,13 @@ def _step_window_chunk(window, rule, gens: int, exterior=None):
     _zero_band_exterior guards), so they are re-zeroed before every
     generation. TORUS needs no mask — the ring holds real wrapped data
     whose free evolution is exact."""
-    r, _ = _rule_halo(rule)
+    r, rw = _rule_halo(rule)
     hr = r * gens
     step1 = _step_fns(rule, window.ndim)[1]
 
     def interior(w, k):
         off = hr - k * r            # halo rows remaining per side
-        return w[..., off:w.shape[-2] - off, 1:-1]
+        return w[..., off:w.shape[-2] - off, rw:w.shape[-1] - rw]
 
     def zero_exterior(w, k):
         row0, col0, ring, H, rw, Wp = exterior
@@ -577,15 +577,26 @@ class SparseEngineState:
             self.rule, self.shape, self.tile_rows, self.tile_words,
             capacity, self.topology, gens=self.chunk_gens, ring_rows=ring
         )
-        # the n % chunk_gens remainder program: same buffer, 1-gen windows
-        self._sparse_many_1 = (
-            self._sparse_many if self.chunk_gens == 1 else _build_sparse_step(
-                self.rule, self.shape, self.tile_rows, self.tile_words,
-                capacity, self.topology, gens=1, ring_rows=ring))
+        # the n % chunk_gens remainder program (same buffer, 1-gen windows)
+        # is built lazily on first remainder use: a capacity escalation
+        # triggered by the bulk program would otherwise pay a second
+        # first-touch compile the run may never need (ADVICE r4)
+        self._sparse_many_1_built = None
         self._dense_once = _build_dense_once(
             self.rule, self.shape, self.tile_rows, self.tile_words,
             self.topology, ring_rows=ring
         )
+
+    @property
+    def _sparse_many_1(self):
+        if self.chunk_gens == 1:
+            return self._sparse_many
+        if self._sparse_many_1_built is None:
+            ring, _ = self._halo
+            self._sparse_many_1_built = _build_sparse_step(
+                self.rule, self.shape, self.tile_rows, self.tile_words,
+                self.capacity, self.topology, gens=1, ring_rows=ring)
+        return self._sparse_many_1_built
 
     def step(self, n: int = 1) -> None:
         """Advance ``n`` generations: the on-device while_loop runs sparse
