@@ -500,9 +500,11 @@ class SparseEngineState:
             # g-fold) DEFAULTS OFF: the scan dominates a per-generation
             # step (measured ~100% of a 32768² CPU generation), but under
             # XLA's CPU lowering the unrolled shrinking-slab window chain
-            # loses more than the scan win (measured 5x slower at g=8 —
+            # loses more than the scan win — the persisted config-#5-shape
+            # A/B (results/config5_sparse_8192_cpu_chunk_ab.json) measured
+            # g=8 at 750 gens/s vs 4784 unchunked (6.4x slower) at 8192²,
             # the same non-fusion that makes the communication-avoiding
-            # sharded runner CPU-slow). Built for the TPU, where the scan
+            # sharded runner CPU-slow. Built for the TPU, where the scan
             # was the measured 26 ms/gen bottleneck of config #5
             # (pre-auto-tiling); scripts/config5_sparse.py --chunk-gens
             # A/Bs it on chip before any default flips.

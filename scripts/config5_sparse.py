@@ -147,8 +147,14 @@ def main() -> None:
     if args.out:
         import platform as platform_mod
 
+        from gameoflifewithactors_tpu.utils import provenance
+
         record = {
             **summary,
+            # provenance stamp (commit + measured_paths) so staleness()
+            # can certify or flag this artifact like any persisted record
+            **provenance.head_stamp(
+                paths=provenance.ITEM_PATHS["config5_sparse"]),
             "jax_version": jax.__version__,
             "device": str(jax.devices()[0]),
             "host": platform_mod.node(),
