@@ -41,6 +41,69 @@ def drop_region(engine: Engine, top: int, left: int, h: int, w: int) -> None:
     engine.set_grid(grid)
 
 
+def _rewrite_shard(engine: Engine, shard_index: int, fn) -> None:
+    """Replace one device shard of a sharded engine's state with
+    ``fn(shard_data)``, leaving every other device buffer untouched.
+
+    Unlike the region injectors above (which round-trip the WHOLE grid
+    through the host via snapshot/set_grid), this touches O(shard) host
+    memory and reassembles the global array from the existing per-device
+    buffers — the honest model of one device's state going bad *in flight*
+    while the rest of the mesh is still good (SURVEY.md §6: "corrupts/
+    drops a shard"). Host-local: shard_index indexes
+    ``state.addressable_shards``."""
+    import jax
+
+    if engine.mesh is None:
+        raise ValueError("shard injectors need a sharded engine (mesh=None)")
+    if engine.backend == "sparse":
+        # sparse-tiled state pairs the grid with an activity map; mutating
+        # the grid behind the map's back would "corrupt" cells inside
+        # sleeping tiles that then never evolve — not a recoverable-fault
+        # model but an engine-invariant violation
+        raise ValueError("shard injectors do not support the sparse backend")
+    state = engine.state
+    shards = state.addressable_shards
+    if not 0 <= shard_index < len(shards):
+        raise IndexError(
+            f"shard_index {shard_index} out of range ({len(shards)} shards)")
+    arrays = []
+    for i, sh in enumerate(shards):
+        data = np.asarray(sh.data)
+        arrays.append(jax.device_put(fn(data) if i == shard_index else data,
+                                     sh.device))
+    # Engine.state is a read-only property; the injector is a privileged
+    # test hook and writes the backing attribute directly — set_grid would
+    # defeat the point (full-grid host round-trip + re-device_put)
+    engine._state = jax.make_array_from_single_device_arrays(
+        state.shape, state.sharding, arrays)
+
+
+def drop_shard(engine: Engine, shard_index: int) -> None:
+    """Zero one device shard in flight — the SPMD 'device lost its state'
+    fault. All-dead is a valid state in every grid representation, so this
+    works on packed, dense, and bit-plane engines alike."""
+    _rewrite_shard(engine, shard_index, np.zeros_like)
+
+
+def corrupt_shard(engine: Engine, shard_index: int, seed: int = 0) -> None:
+    """Overwrite one device shard with random words in flight. Packed
+    binary (2D uint32 bitboard) engines only: arbitrary bits are a valid
+    state there, while dense uint8 or bit-plane stacks would need
+    representation-aware noise to stay in-domain."""
+    state = engine.state
+    if state.ndim != 2 or state.dtype != np.uint32:
+        raise ValueError(
+            "corrupt_shard supports 2D packed uint32 state only; "
+            "use drop_shard for other representations")
+    rng = np.random.default_rng(seed)
+
+    def scramble(data: np.ndarray) -> np.ndarray:
+        return rng.integers(0, 2 ** 32, size=data.shape, dtype=np.uint32)
+
+    _rewrite_shard(engine, shard_index, scramble)
+
+
 # -- validators --------------------------------------------------------------
 
 def population_bounds_validator(min_pop: int = 0, max_pop: Optional[int] = None) -> Validator:

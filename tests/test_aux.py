@@ -177,6 +177,89 @@ def test_halo_bytes_metric():
     assert rec2["halo_bytes"] == 128
 
 
+def test_drop_shard_in_flight_detected_and_recovered(tmp_path):
+    """The SURVEY §6 drop-a-shard-in-flight shape: one device buffer of a
+    2D-mesh banded engine is zeroed at the device-shard level mid-run (no
+    full-grid host round-trip), the damage is provably confined to that
+    shard, an expected-population validator (redundant computation as the
+    failure detector — SPMD determinism makes the clean trajectory exact)
+    detects it at the next checkpoint boundary, and GuardedRun replays to
+    the bit-exact clean trajectory."""
+    import jax
+
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.utils import fault
+
+    rng = np.random.default_rng(11)
+    grid = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+
+    # clean trajectory: expected population at every checkpoint boundary
+    ref = Engine(grid, "B3/S23", mesh=m, backend="pallas")
+    expected_pop = {0: ref.population()}
+    for gen in range(8, 41, 8):
+        ref.step(8)
+        expected_pop[gen] = ref.population()
+    want = ref.snapshot()
+
+    eng = Engine(grid, "B3/S23", mesh=m, backend="pallas")
+    assert eng._banded
+    guard = fault.GuardedRun(
+        eng, checkpoint_every=8,
+        checkpoint_path=str(tmp_path / "shard.npz"),
+        validator=lambda e: e.population() == expected_pop[e.generation])
+    guard.run(16)
+
+    before = eng.snapshot()
+    fault.drop_shard(eng, 3)                   # one band lost in flight
+    after = eng.snapshot()
+    diff_rows = np.flatnonzero((before != after).any(axis=1))
+    assert diff_rows.size, "drop_shard must change the live state"
+    # damage confined to ONE band: the (2,4) mesh flattens to 8 bands of
+    # 8 rows; the zeroed rows all lie in a single 8-row slab, zeroed
+    # full-width, and every other row is untouched
+    band = diff_rows[0] // 8
+    assert np.all(diff_rows // 8 == band)
+    assert not after[band * 8:(band + 1) * 8].any()
+    mask = np.ones(64, dtype=bool)
+    mask[band * 8:(band + 1) * 8] = False
+    np.testing.assert_array_equal(before[mask], after[mask])
+
+    guard.run(24)                              # detector fires, replays
+    assert guard.recoveries >= 1
+    assert eng.generation == 40
+    np.testing.assert_array_equal(eng.snapshot(), want)
+
+
+def test_shard_injectors_refuse_invalid_targets():
+    import jax
+
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.utils import fault
+
+    unsharded = Engine(np.zeros((32, 64), dtype=np.uint8), "B3/S23")
+    with pytest.raises(ValueError, match="sharded"):
+        fault.drop_shard(unsharded, 0)
+
+    m = mesh_lib.make_mesh((2, 4), jax.devices())
+    rng = np.random.default_rng(0)
+    sharded = Engine(rng.integers(0, 2, size=(64, 256), dtype=np.uint8),
+                     "B3/S23", mesh=m, backend="packed")
+    with pytest.raises(IndexError):
+        fault.drop_shard(sharded, 99)
+    # corrupt_shard on a packed binary engine scrambles exactly one shard
+    pre = sharded.snapshot()
+    fault.corrupt_shard(sharded, 1, seed=5)
+    post = sharded.snapshot()
+    assert (pre != post).any()
+    sparse_eng = Engine(rng.integers(0, 2, size=(256, 256), dtype=np.uint8),
+                        "B3/S23", mesh=m, backend="sparse")
+    with pytest.raises(ValueError, match="sparse"):
+        fault.drop_shard(sparse_eng, 0)
+
+
 def test_guarded_run_recovers_banded_2d_mesh_engine(tmp_path):
     """Checkpoint-based recovery over the flattened-band kernel engine on
     a 2D mesh: a corrupted shard mid-run must roll back and replay to the

@@ -1,0 +1,170 @@
+"""Golden-image rendering tests across every rule family (VERDICT r4 #7).
+
+The reference's only observability is its console Renderer [SURVEY.md §6
+metrics row]; this suite pins the TPU framework's equivalent outputs —
+ConsoleRenderer text (plain and ANSI) and save_ppm bytes — against golden
+files in tests/golden/, over a deterministic evolution of each family:
+binary (Conway pulsar), Generations (Brian's Brain, dying-state glyphs and
+grey fade), multi-state C>=3 LtL (decay plane stack), and elementary
+(W30 spacetime diagram). A rendering regression (glyph mapping, fade
+arithmetic, PPM header, status line) shows up as a byte diff; an engine
+regression upstream shows up too, which is intended — the golden is the
+end-to-end "what the user sees".
+
+Regenerate after an INTENDED change with:
+  python tests/test_render_golden.py --regen
+and review the diff before committing.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _render_text(grid: np.ndarray, generation: int, *, ansi: bool,
+                 charset: str = "·█", population=None) -> str:
+    from gameoflifewithactors_tpu.coordinator import RenderFrame
+    from gameoflifewithactors_tpu.utils.render import ConsoleRenderer
+
+    buf = io.StringIO()
+    r = ConsoleRenderer(buf, ansi=ansi, charset=charset)
+    r(RenderFrame(grid=grid, generation=generation, population=population,
+                  full_shape=tuple(grid.shape)))
+    return buf.getvalue()
+
+
+def _conway_pulsar():
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.models import seeds
+
+    grid = np.asarray(seeds.seeded((17, 32), "pulsar", 2, 9))
+    e = Engine(grid, "B3/S23")
+    e.step(2)                      # pulsar is period 3: gen 2 is distinct
+    return e.snapshot(), e.generation, e.population()
+
+
+def _brain_soup():
+    from gameoflifewithactors_tpu import Engine
+
+    rng = np.random.default_rng(42)
+    grid = (rng.random((24, 48)) < 0.3).astype(np.uint8)
+    e = Engine(grid, "brain")
+    e.step(5)
+    return e.snapshot(), e.generation
+
+
+def _ltl_multistate():
+    from gameoflifewithactors_tpu import Engine
+
+    rng = np.random.default_rng(7)
+    grid = rng.integers(0, 4, size=(32, 64), dtype=np.uint8)
+    e = Engine(grid, "R2,C4,M1,S3..8,B5..9")
+    e.step(4)
+    return e.snapshot(), e.generation
+
+
+def _w30_spacetime():
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.models.elementary import parse_elementary
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.elementary import evolve_spacetime
+
+    row = np.zeros(64, dtype=np.uint8)
+    row[32] = 1                    # single seed -> Sierpinski-like W30 cone
+    st = evolve_spacetime(bitpack.pack(jnp.asarray(row[None])), 40,
+                          rule=parse_elementary("W30"))
+    return np.asarray(bitpack.unpack(st[:, 0, :]))
+
+
+def _artifacts() -> dict:
+    """name -> bytes, every golden in one place (tests and --regen share)."""
+    from gameoflifewithactors_tpu.utils.render import save_ppm
+
+    out = {}
+    pulsar, gen, pop = _conway_pulsar()
+    out["conway_pulsar_g2_plain.txt"] = _render_text(
+        pulsar, gen, ansi=False, population=pop).encode()
+    out["conway_pulsar_g2_ansi.txt"] = _render_text(
+        pulsar, gen, ansi=True, population=pop).encode()
+
+    brain, gen = _brain_soup()
+    out["brain_g5_plain.txt"] = _render_text(
+        brain, gen, ansi=False, charset="·█▒").encode()
+
+    def ppm_bytes(grid, scale=1):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".ppm") as f:
+            save_ppm(grid, f.name, scale=scale)
+            return open(f.name, "rb").read()
+
+    out["brain_g5.ppm"] = ppm_bytes(brain)
+    ltl, gen = _ltl_multistate()
+    out["ltl_c4_g4.ppm"] = ppm_bytes(ltl)
+    out["w30_spacetime.ppm"] = ppm_bytes(_w30_spacetime(), scale=2)
+    return out
+
+
+_EXPECTED = sorted((
+    "conway_pulsar_g2_plain.txt", "conway_pulsar_g2_ansi.txt",
+    "brain_g5_plain.txt", "brain_g5.ppm", "ltl_c4_g4.ppm",
+    "w30_spacetime.ppm",
+))
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    return _artifacts()
+
+
+@pytest.mark.parametrize("name", _EXPECTED)
+def test_golden(name, artifacts):
+    path = os.path.join(GOLDEN_DIR, name)
+    assert os.path.exists(path), (
+        f"golden file {name} missing — run `python {__file__} --regen`")
+    got = artifacts[name]
+    want = open(path, "rb").read()
+    assert got == want, (
+        f"{name} drifted from its golden ({len(got)} vs {len(want)} bytes); "
+        f"if the change is intended, regen and review the diff")
+
+
+def test_golden_dir_has_no_strays():
+    on_disk = sorted(f for f in os.listdir(GOLDEN_DIR)
+                     if not f.startswith("."))
+    assert on_disk == _EXPECTED
+
+
+def test_families_visibly_distinct(artifacts):
+    # sanity on the goldens themselves: the Brain PPM shows dying states as
+    # intermediate greys (>2 luminances), the LtL PPM shows 4 states, and
+    # the W30 cone is non-trivial
+    def lums(ppm: bytes):
+        head_end = ppm.index(b"255\n") + 4
+        return set(ppm[head_end::3])
+
+    assert len(lums(artifacts["brain_g5.ppm"])) >= 3
+    assert len(lums(artifacts["ltl_c4_g4.ppm"])) >= 4
+    body = artifacts["conway_pulsar_g2_plain.txt"].decode()
+    assert "█" in body and "·" in body
+    ansi = artifacts["conway_pulsar_g2_ansi.txt"].decode()
+    assert ansi.startswith("\x1b[2J\x1b[H")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" not in sys.argv:
+        raise SystemExit("usage: python tests/test_render_golden.py --regen")
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for name, data in _artifacts().items():
+        with open(os.path.join(GOLDEN_DIR, name), "wb") as f:
+            f.write(data)
+        print(f"wrote {name} ({len(data)} bytes)")
