@@ -124,6 +124,12 @@ for _item, _paths in ITEM_PATHS.items():
         _paths.append("scripts/tpu_worklist.py")
 
 
+def repo_root() -> str:
+    """Absolute path of the repository this package lives in — where the
+    persisted evidence (results/) is found."""
+    return _REPO
+
+
 def _git(*args: str, repo: str | None = None) -> str | None:
     try:
         r = subprocess.run(["git", *args], cwd=repo or _REPO,

@@ -518,3 +518,61 @@ def test_auto_routes_multistate_by_measured_crossover():
     g_odd = np.random.default_rng(5).integers(0, 4, size=(32, 48),
                                               dtype=np.uint8)
     assert Engine(g_odd, "R2,C4,M1,S3..8,B5..9").backend == "dense"
+
+
+def test_tpu_multistate_routing_follows_ltl_planes_evidence(monkeypatch):
+    """On TPU, C >= 3 auto routing is decided by the on-chip ltl_planes
+    capture (VERDICT r4 #5): no usable record -> dense (never route onto
+    an unmeasured path); planes measured faster -> planes; dense measured
+    faster -> dense. The envelope stays the CPU crossover's (diamond or
+    box radius <= 3) — box radius >= 4 is dense regardless."""
+    from gameoflifewithactors_tpu import Engine, engine
+    from gameoflifewithactors_tpu.ops import pallas_stencil
+
+    # simulate the TPU platform for routing only; the plane-stack path the
+    # routing may then pick is plain XLA code and runs on CPU fine
+    monkeypatch.setattr(pallas_stencil, "default_interpret", lambda: False)
+    g4 = np.random.default_rng(5).integers(0, 4, size=(32, 64),
+                                           dtype=np.uint8)
+
+    def with_rates(rates):
+        monkeypatch.setattr(engine, "_ltl_planes_tpu_rates", lambda: rates)
+
+    with_rates(None)
+    assert Engine(g4, "R2,C4,M1,S3..8,B5..9").backend == "dense"
+    with_rates({"planes": 2.0e11, "dense": 1.0e11})
+    assert Engine(g4, "R2,C4,M1,S3..8,B5..9").backend == "packed"
+    assert Engine(g4, "R2,C4,M0,S6..11,B6..9,NN").backend == "packed"
+    # outside the measured-crossover envelope: dense even when planes wins
+    assert Engine(g4, "R5,C4,M1,S34..58,B34..45").backend == "dense"
+    with_rates({"planes": 1.0e11, "dense": 2.0e11})
+    assert Engine(g4, "R2,C4,M1,S3..8,B5..9").backend == "dense"
+
+
+def test_ltl_planes_rates_loader_guards(tmp_path, monkeypatch):
+    """The evidence loader refuses non-TPU and malformed records."""
+    import json
+
+    from gameoflifewithactors_tpu import engine
+    from gameoflifewithactors_tpu.utils import provenance
+
+    def load_with(record):
+        (tmp_path / "results").mkdir(exist_ok=True)
+        (tmp_path / "results" / "tpu_worklist.json").write_text(
+            json.dumps({"ltl_planes": record}))
+        monkeypatch.setattr(provenance, "repo_root", lambda: str(tmp_path))
+        monkeypatch.setattr(engine._ltl_planes_tpu_rates, "cache",
+                            engine._UNSET)
+        try:
+            return engine._ltl_planes_tpu_rates()
+        finally:
+            monkeypatch.setattr(engine._ltl_planes_tpu_rates, "cache",
+                                engine._UNSET)
+
+    good = {"ok": True, "platform": "tpu",
+            "cell_updates_per_sec": {"planes": 2.0, "dense": 1.0}}
+    assert load_with(good) == {"planes": 2.0, "dense": 1.0}
+    assert load_with({**good, "platform": "cpu"}) is None
+    assert load_with({**good, "ok": False}) is None
+    assert load_with({**good, "cell_updates_per_sec": {"planes": 2.0}}) is None
+    assert load_with({**good, "cell_updates_per_sec": "broken"}) is None
