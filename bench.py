@@ -187,6 +187,34 @@ def report() -> None:
                 "stale": st["stale"],
                 "reason": st["reason"],
             })
+    # standalone single-measurement artifacts (config5 captures, ad-hoc
+    # runs): anything in results/ with a metric field that isn't one of
+    # the two stores above. Weak-scaling JSONL series are skipped — they
+    # are many records per file with no single headline value to flag.
+    seen = {PERSIST_PATH, os.path.join(repo, "results", "tpu_worklist.json")}
+    res_dir = os.path.join(repo, "results")
+    for name in sorted(os.listdir(res_dir) if os.path.isdir(res_dir) else []):
+        path = os.path.join(res_dir, name)
+        if path in seen or not name.endswith(".json"):
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue  # JSONL series or non-record files
+        if not isinstance(rec, dict) or "metric" not in rec:
+            continue
+        st = prov.staleness(rec)
+        rows.append({
+            "source": "artifact", "key": name[:-5],
+            "ok": rec.get("ok", True),  # standalone artifacts predate ok
+            "value": rec.get("value"),
+            "unit": rec.get("unit"),
+            "commit": rec.get("commit"),
+            "recorded_at": rec.get("recorded_at"),
+            "stale": st["stale"],
+            "reason": st["reason"],
+        })
     head = prov.git_head()
     fresh = sum(1 for r in rows if r["ok"] and not r["stale"])
     for r in rows:

@@ -62,3 +62,12 @@ def test_long_row_runs(capsys):
     main(["--cells", "2048", "--gens", "64", "--rules", "W30,W184"])
     out = capsys.readouterr().out
     assert "W30" in out and "W184" in out and "8 devices" in out
+
+
+def test_fault_recovery_replays_bit_exact(capsys):
+    from examples.fault_recovery import main
+
+    main(["--side", "64", "--gens", "24", "--checkpoint-every", "4"])
+    out = capsys.readouterr().out
+    assert "dropped device shard" in out
+    assert "final state bit-identical to the unfaulted run" in out

@@ -144,6 +144,11 @@ def test_bench_report_scoreboard():
     # the committed store always has the headline auto record
     assert any(ln.split()[2] == "auto:default:B3/S23"
                for ln in r.stdout.splitlines() if ln.startswith(("FRESH", "stale")))
+    # standalone artifacts (config5 captures etc.) are on the scoreboard
+    # too — one glance covers ALL persisted evidence, not just the stores
+    assert any(ln.split()[1] == "artifact"
+               for ln in r.stdout.splitlines()
+               if ln.startswith(("FRESH", "stale", "FAILED")))
 
 
 def test_worklist_children_smoke_cpu():
@@ -270,3 +275,29 @@ def test_roofline_report_renders_from_trace_record():
     bad.pop("perfetto")
     assert rr.render_roofline({"profile_trace": bad}, {}) is None
     assert rr.render_roofline({}, {}) is None
+
+
+def test_worklist_merge_embeds_measured_paths(tmp_path, monkeypatch):
+    """_merge stamps new records with the item's measured file set so they
+    self-describe (round-5 provenance precision); results that carry their
+    own commit are kept whole."""
+    import importlib.util
+    import json
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_worklist_merge_test", os.path.join(REPO, "scripts", "tpu_worklist.py"))
+    wl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wl)
+    out = tmp_path / "worklist.json"
+    monkeypatch.setattr(wl, "OUT_PATH", str(out))
+
+    wl._merge("pallas_identity", {"ok": True, "platform": "t"})
+    rec = json.loads(out.read_text())["pallas_identity"]
+    assert rec["measured_paths"] == wl._provenance().ITEM_PATHS["pallas_identity"]
+    assert "gameoflifewithactors_tpu/ops/sparse.py" not in rec["measured_paths"]
+
+    # a result with its own provenance is not re-stamped
+    wl._merge("bench_packed", {"ok": True, "commit": "deadbee", "value": 1})
+    rec = json.loads(out.read_text())["bench_packed"]
+    assert rec["commit"] == "deadbee" and "measured_paths" not in rec
