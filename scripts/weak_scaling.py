@@ -47,6 +47,10 @@ def main() -> None:
                     help="G>1 uses the communication-avoiding runner (one "
                          "depth-G halo exchange per G generations; "
                          "sharded.make_multi_step_packed_deep)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write {summary, series, provenance stamp} as "
+                         "one JSON dict — the scoreboard-visible artifact "
+                         "form (bench.py --report)")
     ap.add_argument("--runner", default="packed",
                     choices=["packed", "band", "sparse-tiled"],
                     help="sharded runner under test: 'packed' (per-gen XLA "
@@ -196,14 +200,32 @@ def main() -> None:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    print(json.dumps({
+    summary = {
         "metric": f"weak-scaling efficiency, {th}x{tw}/device, {rule.notation} "
                   f"({platform}, runner={args.runner}, "
                   f"G={args.gens_per_exchange})",
         "value": results[-1]["weak_scaling_efficiency"],
         "unit": "fraction",
         "devices": results[-1]["devices"],
-    }))
+    }
+    print(json.dumps(summary))
+    if args.out:
+        from gameoflifewithactors_tpu.utils import provenance
+
+        paths = [f"gameoflifewithactors_tpu/parallel/{f}" for f in
+                 ("sharded.py", "halo.py", "mesh.py")]
+        paths += [f"gameoflifewithactors_tpu/ops/{f}" for f in
+                  ("packed.py", "sparse.py", "pallas_stencil.py",
+                   "_jit.py", "stencil.py", "bitpack.py")]
+        paths += ["gameoflifewithactors_tpu/models/rules.py",
+                  "scripts/weak_scaling.py"]
+        record = {**summary, "series": results,
+                  **provenance.head_stamp(paths=paths),
+                  "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())}
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
     return
 
 
