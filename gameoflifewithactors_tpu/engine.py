@@ -15,6 +15,7 @@ backend fetches one scalar per step() call — see Engine.step.)
 from __future__ import annotations
 
 import warnings
+from functools import lru_cache
 from typing import Optional, Tuple
 
 import jax
@@ -36,6 +37,7 @@ from .parallel import sharded
 BACKENDS = ("packed", "dense", "pallas", "sparse")
 
 
+@lru_cache(maxsize=1)
 def _ltl_planes_tpu_rates() -> Optional[dict]:
     """On-chip planes-vs-dense rates from the ``ltl_planes`` worklist
     record (results/tpu_worklist.json, captured by scripts/tpu_worklist.py
@@ -46,14 +48,11 @@ def _ltl_planes_tpu_rates() -> Optional[dict]:
     process — routing is decided at Engine construction and a mid-process
     recapture changing the verdict would make identical constructors
     disagree."""
-    if _ltl_planes_tpu_rates.cache is not _UNSET:
-        return _ltl_planes_tpu_rates.cache
     import json
     import os
 
     from .utils import provenance
 
-    rates: Optional[dict] = None
     try:
         with open(os.path.join(provenance.repo_root(), "results",
                                "tpu_worklist.json")) as f:
@@ -62,16 +61,11 @@ def _ltl_planes_tpu_rates() -> Optional[dict]:
             got = rec.get("cell_updates_per_sec") or {}
             if isinstance(got.get("planes"), (int, float)) \
                     and isinstance(got.get("dense"), (int, float)):
-                rates = {"planes": float(got["planes"]),
-                         "dense": float(got["dense"])}
+                return {"planes": float(got["planes"]),
+                        "dense": float(got["dense"])}
     except (OSError, json.JSONDecodeError, AttributeError):
-        rates = None
-    _ltl_planes_tpu_rates.cache = rates
-    return rates
-
-
-_UNSET = object()
-_ltl_planes_tpu_rates.cache = _UNSET
+        pass
+    return None
 
 
 def _chunked(bulk, pergen, g: int):
@@ -784,8 +778,6 @@ class Engine:
                     # advertised default, so a regression in the
                     # measurement path must be visible to 'auto' callers,
                     # not only on an explicit source='measured' probe.
-                    import warnings
-
                     self._halo_hlo_err = exc
                     warnings.warn(
                         "halo_bytes_per_gen: HLO measurement failed "

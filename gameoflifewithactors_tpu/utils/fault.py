@@ -15,6 +15,7 @@ import tempfile
 from pathlib import Path
 from typing import Callable, Optional
 
+import jax
 import numpy as np
 
 from ..engine import Engine
@@ -52,8 +53,6 @@ def _rewrite_shard(engine: Engine, shard_index: int, fn) -> None:
     while the rest of the mesh is still good (SURVEY.md §6: "corrupts/
     drops a shard"). Host-local: shard_index indexes
     ``state.addressable_shards``."""
-    import jax
-
     if engine.mesh is None:
         raise ValueError("shard injectors need a sharded engine (mesh=None)")
     if engine.backend == "sparse":

@@ -561,13 +561,11 @@ def test_ltl_planes_rates_loader_guards(tmp_path, monkeypatch):
         (tmp_path / "results" / "tpu_worklist.json").write_text(
             json.dumps({"ltl_planes": record}))
         monkeypatch.setattr(provenance, "repo_root", lambda: str(tmp_path))
-        monkeypatch.setattr(engine._ltl_planes_tpu_rates, "cache",
-                            engine._UNSET)
+        engine._ltl_planes_tpu_rates.cache_clear()
         try:
             return engine._ltl_planes_tpu_rates()
         finally:
-            monkeypatch.setattr(engine._ltl_planes_tpu_rates, "cache",
-                                engine._UNSET)
+            engine._ltl_planes_tpu_rates.cache_clear()
 
     good = {"ok": True, "platform": "tpu",
             "cell_updates_per_sec": {"planes": 2.0, "dense": 1.0}}
