@@ -36,7 +36,9 @@ def _run_elementary(cfg, args, rule) -> int:
     # the requested side effect (a later --resume on the missing file
     # would fail far from the cause)
     for flag, value in (("--checkpoint", cfg.checkpoint),
-                        ("--metrics", cfg.metrics), ("--mesh", cfg.mesh)):
+                        ("--metrics", cfg.metrics), ("--mesh", cfg.mesh),
+                        ("--ppm-every", cfg.ppm_every or None),
+                        ("--save-rle", cfg.save_rle)):
         if value is not None:
             raise SystemExit(
                 f"{flag} is not supported for 1D W-rules (the spacetime "
@@ -97,11 +99,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.render == "live":
         coordinator.subscribe(ConsoleRenderer())
+    seq = None
+    if cfg.ppm_every:
+        if not cfg.ppm:
+            raise SystemExit("--ppm-every needs --ppm PATH as the "
+                             "filename stem for the frame sequence")
+        import numpy as np
+
+        from .utils.render import PpmSequenceWriter
+
+        seq = PpmSequenceWriter(cfg.ppm)
+        # full-resolution snapshots, not the console's downsampled view
+        # (the user controls cost via grid size and cadence); the initial
+        # state is frame 0 so a movie starts from the seed
+        coordinator.subscribe(
+            lambda frame: seq.write(np.asarray(coordinator.engine.snapshot()),
+                                    frame.generation))
+        seq.write(np.asarray(coordinator.engine.snapshot()),
+                  coordinator.generation)
     # Pacing (rate limit / periodic metrics / live frames) needs the tick
     # loop; otherwise the whole run is one device dispatch.
     needs_pacing = args.render == "live" or cfg.rate_hz or cfg.metrics
     if needs_pacing:
         scheduler.run(max_generations=cfg.steps)
+    elif seq is not None:
+        # surface a frame to the sequence every N generations
+        coordinator.run(cfg.steps, render_every=cfg.ppm_every)
     else:
         coordinator.run(cfg.steps)
 
@@ -113,13 +136,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         frame = coordinator.current_frame()
         print(f"gen {frame.generation}  pop {frame.population}")
 
-    if cfg.ppm:
+    if seq is not None:
+        print(f"{len(seq.paths)} frames written: {seq.paths[0]} .. "
+              f"{seq.paths[-1]}", file=sys.stderr)
+    elif cfg.ppm:
         import numpy as np
 
         from .utils.render import save_ppm
 
         save_ppm(np.asarray(coordinator.engine.snapshot()), cfg.ppm)
         print(f"final frame written: {cfg.ppm}", file=sys.stderr)
+
+    if cfg.save_rle:
+        import numpy as np
+
+        from .models import seeds as seeds_lib
+
+        grid = np.asarray(coordinator.engine.snapshot())
+        if grid.max(initial=0) > 1:
+            raise SystemExit(
+                "--save-rle encodes binary states only; this rule "
+                f"({cfg.rule}) produced multi-state cells — use --ppm "
+                "or --checkpoint for multi-state universes")
+        with open(cfg.save_rle, "w") as f:
+            f.write(seeds_lib.to_rle(grid, rule=cfg.rule))
+        print(f"RLE written: {cfg.save_rle}", file=sys.stderr)
 
     if cfg.checkpoint:
         from .utils import checkpoint as ckpt_lib

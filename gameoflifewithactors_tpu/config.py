@@ -44,6 +44,8 @@ class SimulationConfig:
     checkpoint: Optional[str] = None        # save path (written at end)
     resume: Optional[str] = None            # checkpoint to resume from
     ppm: Optional[str] = None               # final-frame / spacetime PPM path
+    ppm_every: int = 0                      # full-res frame sequence cadence
+    save_rle: Optional[str] = None          # final state as RLE (binary rules)
 
     # -- assembly ------------------------------------------------------------
 
@@ -201,6 +203,18 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--ppm", default=None, metavar="PATH",
                    help="write the final grid (2D rules) or the full "
                         "spacetime diagram (1D W-rules) as a PPM image")
+    p.add_argument("--ppm-every", type=int, default=0, metavar="N",
+                   help="with --ppm PATH: write a FULL-resolution frame "
+                        "every N generations as PATH-stem_NNNNNN.ppm "
+                        "(ffmpeg-ready sequence; the final single --ppm "
+                        "write is skipped — the last frame is in the "
+                        "sequence). Under --render live/--rate/--metrics "
+                        "the sequence follows the tick cadence "
+                        "(--render-every) instead")
+    p.add_argument("--save-rle", default=None, metavar="PATH",
+                   help="write the final state as standard RLE (Golly-"
+                        "compatible; binary rules only — round-trips with "
+                        "--seed @file.rle)")
     p.add_argument("--resume", default=None, metavar="PATH",
                    help="resume from a checkpoint (the checkpoint's grid/rule/"
                         "seed/topology win; --grid/--rule/--seed/--topology are ignored)")
@@ -234,5 +248,7 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         checkpoint=args.checkpoint,
         resume=args.resume,
         ppm=args.ppm,
+        ppm_every=args.ppm_every,
+        save_rle=args.save_rle,
     )
     return cfg, args

@@ -56,6 +56,31 @@ class ConsoleRenderer:
         self._first = False
 
 
+class PpmSequenceWriter:
+    """Numbered PPM frames for movie-making: ``stem_000123.ppm`` per write
+    (ffmpeg consumes the pattern directly: ``ffmpeg -i stem_%06d.ppm``).
+    Usable as a RenderFrame subscriber (writes the frame's possibly
+    downsampled view) or via :meth:`write` with any grid — the CLI's
+    ``--ppm-every`` feeds it full-resolution snapshots."""
+
+    def __init__(self, path: str, *, scale: int = 1):
+        import os
+
+        base, ext = os.path.splitext(path)
+        self._fmt = f"{base}_{{gen:06d}}{ext or '.ppm'}"
+        self.scale = scale
+        self.paths: list = []
+
+    def write(self, grid, generation: int) -> str:
+        path = self._fmt.format(gen=generation)
+        save_ppm(grid, path, scale=self.scale)
+        self.paths.append(path)
+        return path
+
+    def __call__(self, frame: RenderFrame) -> None:
+        self.write(frame.grid, frame.generation)
+
+
 def save_ppm(grid, path, *, scale: int = 1) -> None:
     """Write a state grid as a binary PPM (P6) image — the no-dependency
     image format every viewer and converter reads. State 0 is black, state

@@ -294,3 +294,51 @@ def test_multistate_ltl_checkpoint_across_layouts(tmp_path):
         back.step(5)
         np.testing.assert_array_equal(back.snapshot(), src.snapshot(),
                                       err_msg=backend)
+
+
+def test_cli_ppm_sequence_and_rle_round_trip(tmp_path, capsys):
+    """--ppm-every writes an ffmpeg-ready full-resolution frame sequence
+    (initial state included); --save-rle exports the final state as
+    standard RLE that --seed @file.rle reloads bit-exactly (the Golly
+    round trip)."""
+    import glob
+
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models import seeds as seeds_lib
+
+    stem = tmp_path / "movie.ppm"
+    rle = tmp_path / "final.rle"
+    rc = cli_main(
+        ["--grid", "16x32", "--seed", "glider", "--steps", "8",
+         "--ppm", str(stem), "--ppm-every", "4", "--save-rle", str(rle)])
+    assert rc == 0
+    frames = sorted(glob.glob(str(tmp_path / "movie_*.ppm")))
+    # gens 0 (seed), 4, 8 — and no single final movie.ppm write
+    assert [f.rsplit("_", 1)[1] for f in frames] == [
+        "000000.ppm", "000004.ppm", "000008.ppm"]
+    assert not stem.exists()
+
+    # the exported RLE reloads to the exact final state: glider at gen 8
+    # on 16x32 has translated (2, 2) from its seeded origin
+    reloaded = seeds_lib.from_rle(rle.read_text())
+    ck = tmp_path / "after.npz"
+    rc = cli_main(["--grid", "16x32", "--seed", f"@{rle}", "--seed-at", "0x0",
+                   "--steps", "0", "--checkpoint", str(ck)])
+    assert rc == 0
+    grid, _ = ckpt.load_grid(ck)
+    ys, xs = np.nonzero(grid)
+    assert grid.sum() == 5 == reloaded.sum()
+
+
+def test_cli_ppm_every_needs_stem_and_rejects_multistate(tmp_path):
+    import pytest
+
+    with pytest.raises(SystemExit, match="--ppm PATH"):
+        cli_main(["--grid", "16x32", "--steps", "2", "--ppm-every", "2"])
+    with pytest.raises(SystemExit, match="binary"):
+        cli_main(["--grid", "16x32", "--seed", "random", "--rule", "brain",
+                  "--steps", "2", "--save-rle", str(tmp_path / "x.rle")])
+    with pytest.raises(SystemExit, match="--save-rle"):
+        cli_main(["--rule", "W30", "--grid", "1x32", "--steps", "2",
+                  "--save-rle", str(tmp_path / "y.rle")])
