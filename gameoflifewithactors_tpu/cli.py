@@ -152,12 +152,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         from .models import seeds as seeds_lib
 
+        # binary rules write legacy b/o tokens; multi-state universes get
+        # Golly's extended encoding, with the rule in the header so
+        # decoders pick the extended reading
         grid = np.asarray(coordinator.engine.snapshot())
-        if grid.max(initial=0) > 1:
-            raise SystemExit(
-                "--save-rle encodes binary states only; this rule "
-                f"({cfg.rule}) produced multi-state cells — use --ppm "
-                "or --checkpoint for multi-state universes")
         with open(cfg.save_rle, "w") as f:
             f.write(seeds_lib.to_rle(grid, rule=cfg.rule))
         print(f"RLE written: {cfg.save_rle}", file=sys.stderr)

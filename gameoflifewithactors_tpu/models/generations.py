@@ -9,8 +9,9 @@ no longer excites neighbors), and only from state C-1 does it return to
 dead 0. C=2 degenerates to plain life-like, so C >= 3 here.
 
 Notation: "B2/S/C3" (Brian's Brain) — also accepted with G instead of C,
-and Golly's "survive/born/states" digit form "2/13/21" is not supported
-(ambiguous with multi-digit counts); use the explicit B/S/C form.
+and as Golly's "survive/born/states" digit form ("2/3/3" ≡ B3/S2/C3 is
+what Golly writes in RLE headers; counts are single digits 0..8, so the
+form is unambiguous — only the trailing states field is multi-digit).
 """
 
 from __future__ import annotations
@@ -71,6 +72,8 @@ class GenRule:
 _GEN_RE = re.compile(
     r"^B(?P<b>[0-8]*)/S(?P<s>[0-8]*)/[CG](?P<c>\d+)$", re.IGNORECASE
 )
+# Golly's RLE-header form: survive/born/states ("2/3/3" = Brian's Brain)
+_GOLLY_GEN_RE = re.compile(r"^(?P<s>[0-8]*)/(?P<b>[0-8]*)/(?P<c>\d+)$")
 
 GENERATIONS_REGISTRY = {}
 
@@ -95,11 +98,11 @@ def parse_generations(spec: "str | GenRule") -> GenRule:
     if key in GENERATIONS_REGISTRY:
         return GENERATIONS_REGISTRY[key]
     # match the space-stripped key, so 'B2 / S / C3' parses
-    m = _GEN_RE.match(key)
+    m = _GEN_RE.match(key) or _GOLLY_GEN_RE.match(key)
     if not m:
         raise ValueError(
-            f"not a Generations rule: {spec!r} (want 'B…/S…/C<n>' or one of "
-            f"{sorted(GENERATIONS_REGISTRY)})"
+            f"not a Generations rule: {spec!r} (want 'B…/S…/C<n>', Golly's "
+            f"'survive/born/states', or one of {sorted(GENERATIONS_REGISTRY)})"
         )
     return GenRule(
         frozenset(int(x) for x in m.group("b")),
@@ -119,7 +122,8 @@ def parse_any(spec):
     if isinstance(spec, (Rule, GenRule, LtLRule, ElementaryRule)):
         return spec
     key = spec.strip().lower().replace(" ", "").replace("'", "")
-    if key in GENERATIONS_REGISTRY or _GEN_RE.match(key):
+    if (key in GENERATIONS_REGISTRY or _GEN_RE.match(key)
+            or _GOLLY_GEN_RE.match(key)):
         return parse_generations(spec)
     if key in LTL_REGISTRY or _LTL_RE.match(key):
         return parse_ltl(spec)

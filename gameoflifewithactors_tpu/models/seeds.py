@@ -34,9 +34,37 @@ def from_plaintext(text: str) -> np.ndarray:
 _RLE_HEADER = re.compile(r"^\s*x\s*=\s*(\d+)\s*,\s*y\s*=\s*(\d+)", re.IGNORECASE)
 
 
-def from_rle(text: str) -> np.ndarray:
-    """Decode standard Game-of-Life RLE (``b``=dead, ``o``=alive, ``$``=EOL,
-    ``!``=end, ``#``-comment lines, optional ``x=,y=,rule=`` header)."""
+_RLE_RULE = re.compile(r"rule\s*=\s*([^\n]+)", re.IGNORECASE)
+
+
+def _header_states(text: str) -> int:
+    """Cell-state count from an RLE header's ``rule =`` clause (2 when the
+    rule is binary, absent, or unparseable — the legacy decoder then
+    applies)."""
+    m = _RLE_RULE.search(text)
+    if not m:
+        return 2
+    try:
+        from .generations import parse_any
+
+        return getattr(parse_any(m.group(1).strip()), "states", 2)
+    except Exception:
+        return 2
+
+
+def from_rle(text: str, states: int | None = None) -> np.ndarray:
+    """Decode Game-of-Life RLE (``b``=dead, ``o``=alive, ``$``=EOL,
+    ``!``=end, ``#``-comment lines, optional ``x=,y=,rule=`` header).
+
+    Golly's EXTENDED multi-state encoding is applied when the header's
+    rule (or an explicit ``states=``) has more than 2 states: ``.`` is
+    state 0, ``A``..``X`` are 1..24, and a ``p``..``y`` prefix adds
+    24·k (``pA``=25 … ``yO``=255) — the format Golly writes for
+    Generations and multi-state Larger-than-Life patterns. Binary RLE
+    keeps the legacy case-insensitive ``b``/``o`` reading."""
+    if states is None:
+        states = _header_states(text)
+    multistate = states > 2
     width = height = None
     body_parts = []
     for ln in text.splitlines():
@@ -51,13 +79,24 @@ def from_rle(text: str) -> np.ndarray:
     body = "".join(body_parts)
     rows: list[list[int]] = [[]]
     run = ""
+    prefix = 0                      # 24·k from a pending p..y prefix char
     for ch in body:
         if ch.isdigit():
             run += ch
             continue
+        if multistate and "p" <= ch <= "y":
+            if prefix:
+                raise ValueError(f"double state prefix before {ch!r}")
+            prefix = 24 * (ord(ch) - ord("o"))
+            continue
         n = int(run) if run else 1
         run = ""
-        if ch in ("b", "B"):
+        if prefix and not ("A" <= ch <= "X"):
+            raise ValueError(f"state prefix must be followed by A..X, got {ch!r}")
+        if multistate and "A" <= ch <= "X":
+            rows[-1].extend([prefix + ord(ch) - ord("A") + 1] * n)
+            prefix = 0
+        elif ch in ("b", "B") or (multistate and ch == "."):
             rows[-1].extend([0] * n)
         elif ch in ("o", "O"):
             rows[-1].extend([1] * n)
@@ -79,9 +118,23 @@ def from_rle(text: str) -> np.ndarray:
     return grid
 
 
+def _rle_token(state: int) -> str:
+    """Golly cell token: ``.`` / ``A``..``X`` / prefixed ``pA``..``yO``."""
+    if state == 0:
+        return "."
+    if state > 255:
+        raise ValueError(f"RLE encodes states 0..255, got {state}")
+    k, rem = divmod(state - 1, 24)
+    return (chr(ord("o") + k) if k else "") + chr(ord("A") + rem)
+
+
 def to_rle(grid: np.ndarray, rule: str = "B3/S23") -> str:
-    """Encode a uint8 grid as standard RLE (round-trips with from_rle)."""
+    """Encode a uint8 grid as RLE (round-trips with from_rle). Grids with
+    cells beyond 1 use Golly's extended multi-state tokens; pass the
+    matching multi-state ``rule`` string so decoders (including ours)
+    pick the extended reading from the header."""
     h, w = grid.shape
+    multistate = int(grid.max(initial=0)) > 1
     out = [f"x = {w}, y = {h}, rule = {rule}"]
     lines = []
     for r in range(h):
@@ -89,13 +142,17 @@ def to_rle(grid: np.ndarray, rule: str = "B3/S23") -> str:
         row = grid[r]
         c = 0
         while c < w:
-            v = row[c]
+            v = int(row[c])
             n = 1
             while c + n < w and row[c + n] == v:
                 n += 1
-            runs.append((n, "o" if v else "b"))
+            if multistate:
+                tok = _rle_token(v)
+            else:
+                tok = "o" if v else "b"
+            runs.append((n, tok))
             c += n
-        if runs and runs[-1][1] == "b":
+        if runs and runs[-1][1] in ("b", "."):
             runs.pop()  # trailing dead cells are implicit
         lines.append("".join((str(n) if n > 1 else "") + t for n, t in runs))
     out.append("$".join(lines) + "!")

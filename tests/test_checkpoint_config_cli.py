@@ -331,14 +331,35 @@ def test_cli_ppm_sequence_and_rle_round_trip(tmp_path, capsys):
     assert grid.sum() == 5 == reloaded.sum()
 
 
-def test_cli_ppm_every_needs_stem_and_rejects_multistate(tmp_path):
+def test_cli_ppm_every_needs_stem_and_1d_rejects_rle(tmp_path):
     import pytest
 
     with pytest.raises(SystemExit, match="--ppm PATH"):
         cli_main(["--grid", "16x32", "--steps", "2", "--ppm-every", "2"])
-    with pytest.raises(SystemExit, match="binary"):
-        cli_main(["--grid", "16x32", "--seed", "random", "--rule", "brain",
-                  "--steps", "2", "--save-rle", str(tmp_path / "x.rle")])
     with pytest.raises(SystemExit, match="--save-rle"):
         cli_main(["--rule", "W30", "--grid", "1x32", "--steps", "2",
                   "--save-rle", str(tmp_path / "y.rle")])
+
+
+def test_cli_save_rle_multistate_round_trip(tmp_path):
+    """A Generations universe exports as Golly extended RLE and reloads
+    bit-exactly through --seed @file.rle (dying states included)."""
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models import seeds as seeds_lib
+
+    rle = tmp_path / "brain.rle"
+    ck1 = tmp_path / "a.npz"
+    cli_main(["--grid", "16x32", "--seed", "random", "--rule", "brain",
+              "--rng-seed", "3", "--steps", "3",
+              "--save-rle", str(rle), "--checkpoint", str(ck1)])
+    grid1, _ = ckpt.load_grid(ck1)
+    assert grid1.max() > 1, "want dying states in the exported universe"
+    assert "rule = brain" in rle.read_text()
+    np.testing.assert_array_equal(seeds_lib.from_rle(rle.read_text()), grid1)
+
+    ck2 = tmp_path / "b.npz"
+    cli_main(["--grid", "16x32", "--seed", f"@{rle}", "--seed-at", "0x0",
+              "--rule", "brain", "--steps", "0", "--checkpoint", str(ck2)])
+    grid2, _ = ckpt.load_grid(ck2)
+    np.testing.assert_array_equal(grid2, grid1)

@@ -116,3 +116,48 @@ def test_save_ppm_many_state_fade_distinct(tmp_path):
     assert dying.max() < 255                      # no dying state reads alive
     assert (np.diff(dying.astype(int)) <= 0).all()  # monotone fade
     assert dying.min() >= 95                      # still visible vs dead black
+
+
+class TestExtendedRle:
+    """Golly multi-state RLE (. / A..X / pA..yO tokens)."""
+
+    def test_multistate_round_trip_random(self):
+        rng = np.random.default_rng(9)
+        grid = rng.integers(0, 5, size=(12, 31), dtype=np.uint8)
+        text = seeds.to_rle(grid, rule="R2,C5,M1,S3..8,B5..9")
+        assert "rule = R2,C5,M1,S3..8,B5..9" in text
+        np.testing.assert_array_equal(seeds.from_rle(text), grid)
+
+    def test_prefixed_states_round_trip(self):
+        # states needing p..y prefixes: 24 (X), 25 (pA), 48 (pX), 49 (qA),
+        # 255 (yO) — explicit states= since no rule string names 256 states
+        grid = np.array([[0, 1, 24, 25], [48, 49, 254, 255]], dtype=np.uint8)
+        text = seeds.to_rle(grid, rule="B3/S23")
+        assert "pA" in text and "yO" in text
+        np.testing.assert_array_equal(seeds.from_rle(text, states=256), grid)
+
+    def test_golly_written_form_decodes(self):
+        # the shape Golly writes for a Brian's Brain patch: dot for dead,
+        # A/B for firing/dying, run counts on multi-char tokens
+        text = ("x = 6, y = 2, rule = 2/3/3\n"
+                "3.A2B$2.2A!\n")
+        want = np.array([[0, 0, 0, 1, 2, 2],
+                         [0, 0, 1, 1, 0, 0]], dtype=np.uint8)
+        np.testing.assert_array_equal(seeds.from_rle(text), want)
+
+    def test_binary_files_keep_legacy_reading(self):
+        # uppercase B/O stay dead/alive when the rule is binary — the
+        # extended letters only apply to multi-state headers
+        text = "x = 3, y = 1, rule = B3/S23\nBOB!\n"
+        np.testing.assert_array_equal(
+            seeds.from_rle(text), np.array([[0, 1, 0]], dtype=np.uint8))
+
+    def test_errors(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="0..255"):
+            seeds.to_rle(np.full((1, 1), 256, dtype=np.uint16))
+        with pytest.raises(ValueError, match="prefix"):
+            seeds.from_rle("x = 2, y = 1, rule = 2/3/3\npp!\n")
+        with pytest.raises(ValueError, match="prefix"):
+            seeds.from_rle("x = 2, y = 1, rule = 2/3/3\npb!\n")
