@@ -52,7 +52,14 @@ _ITEM_WATCHDOG_S = {"pallas_autotune": 1500.0, "ltl_bosco": 1500.0,
                     # --chunk-ab roughly doubles the run (second 65536²
                     # seed + compile + benchmark); a watchdog kill must
                     # not discard the headline half with it
-                    "config5_sparse": 1500.0}
+                    "config5_sparse": 1500.0,
+                    # first-ever native Mosaic compiles, several unrolled
+                    # kernel variants each (box/diamond/band x topologies)
+                    # at minutes per compile (the autotune lesson) — a
+                    # watchdog kill mid-compile is also the known
+                    # kill-a-child-wedges-the-tunnel hazard, so give the
+                    # first compiles room to finish
+                    "pallas_generations": 1500.0, "ltl_pallas": 1800.0}
 
 
 def _watchdog_for(item: str) -> float:
