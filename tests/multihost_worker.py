@@ -147,6 +147,28 @@ def main() -> None:
         jnp.asarray(epacked), 24, rule=w110, topology=Topology.TORUS))
     np.testing.assert_array_equal(eout, want_e)
 
+    # distributed checkpoint/resume: gather the LIVE sharded state to this
+    # host mid-run, serialize it, restore onto a fresh global placement,
+    # and resume — the recovery path a lost-process restart takes
+    # (SURVEY §6 failure-detection row composed with the multi-host
+    # runtime). Every process does the full round trip independently and
+    # must land on the 120-generation oracle bit-exactly.
+    import tempfile
+
+    half = multihost.gather_global(
+        run(multihost.put_global_grid(packed, mesh), 60))
+    fd, ckpath = tempfile.mkstemp(suffix=f"_mh{pid}.npz")
+    os.close(fd)
+    try:
+        np.savez(ckpath, grid=half, generation=60)
+        loaded = np.load(ckpath)
+        assert int(loaded["generation"]) == 60
+        resumed = multihost.gather_global(
+            run(multihost.put_global_grid(loaded["grid"], mesh), 60))
+    finally:
+        os.unlink(ckpath)
+    np.testing.assert_array_equal(resumed, want)
+
     print(f"MULTIHOST-OK proc={pid}/{n_procs} devices={len(jax.devices())}",
           flush=True)
 
