@@ -81,11 +81,40 @@ def _run_elementary(cfg, args, rule) -> int:
     return 0
 
 
+def _list_registries() -> int:
+    """``--list``: what names ``--seed`` and ``--rule`` accept (plus the
+    notation forms each family parses)."""
+    from .models import seeds
+    from .models.elementary import parse_elementary
+    from .models.generations import GENERATIONS_REGISTRY
+    from .models.ltl import LTL_REGISTRY
+    from .models.rules import RULE_REGISTRY
+
+    print("seed patterns (--seed NAME, or @file.rle / random / empty):")
+    for name in sorted(seeds.PATTERNS):
+        h, w = seeds.PATTERNS[name].shape
+        print(f"  {name:16} {h}x{w}")
+    print("\nlife-like rules (--rule, also any 'B…/S…' or classic 'S/B'):")
+    for name, r in sorted(RULE_REGISTRY.items()):
+        print(f"  {name:16} {r.notation}")
+    print("\nGenerations rules (also 'B…/S…/C<n>' or Golly 'S/B/C'):")
+    for name, r in sorted(GENERATIONS_REGISTRY.items()):
+        print(f"  {name:16} {r.notation}")
+    print("\nLarger-than-Life rules (also 'R,C,M,S..,B..[,NN]' HROT form):")
+    for name, r in sorted(LTL_REGISTRY.items()):
+        print(f"  {name:16} {r.notation}")
+    print("\nelementary (1D): W0..W255, e.g. "
+          f"{parse_elementary('W110').notation}")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from .utils.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
     cfg, args = from_args(argv)
+    if args.list:
+        return _list_registries()
 
     from .models.elementary import ElementaryRule
     from .models.generations import parse_any

@@ -218,6 +218,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", default=None, metavar="PATH",
                    help="resume from a checkpoint (the checkpoint's grid/rule/"
                         "seed/topology win; --grid/--rule/--seed/--topology are ignored)")
+    p.add_argument("--list", action="store_true",
+                   help="print the registered seed patterns and named rules "
+                        "of every family, then exit")
     return p
 
 

@@ -363,3 +363,11 @@ def test_cli_save_rle_multistate_round_trip(tmp_path):
               "--rule", "brain", "--steps", "0", "--checkpoint", str(ck2)])
     grid2, _ = ckpt.load_grid(ck2)
     np.testing.assert_array_equal(grid2, grid1)
+
+
+def test_cli_list_registries(capsys):
+    rc = cli_main(["--list"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gosper_gun" in out and "B3/S23" in out
+    assert "brain" in out and "bosco" in out and "W0..W255" in out
