@@ -37,6 +37,7 @@ evidence must never look *fresher* than it can be proven to be.
 
 from __future__ import annotations
 
+import ast
 import io
 import os
 import subprocess
@@ -212,6 +213,63 @@ def _code_equivalent_uncached(commit: str, path: str, repo: str | None) -> bool:
     return old_t is not None and old_t == new_t
 
 
+def _protocol_scope(path: str, item: str | None) -> tuple[str, ...] | None:
+    """The functions within ``path`` that constitute a record's measurement
+    protocol, or None when the whole file is the measured surface.
+
+    Protocol files (bench.py, scripts/tpu_worklist.py) mix measurement
+    code with serving/reporting/orchestration; an edit to the latter
+    cannot change what a record measured. Scoping staleness to the
+    protocol functions is what keeps a mid-window fix to ONE failing
+    worklist child from re-staling every record captured minutes earlier
+    in the same window (and so re-burning it). tpu_worklist scoping needs
+    the record's item (each child function is its own protocol); with no
+    item known the whole file stays the conservative surface. Module-
+    level edits outside these functions (e.g. the _SMOKE default) are
+    accepted as non-measurement by this contract."""
+    if path == "bench.py":
+        return ("run_bench",)
+    if path == "scripts/tpu_worklist.py" and item:
+        return ("_bench_rate", "_sync_scalar", "_device_equal",
+                f"child_{item}")
+    return None
+
+
+def _fn_tokens(src: str, name: str) -> list | None:
+    """Token stream of top-level function ``name`` in ``src`` (comments/
+    blank lines dropped), or None when absent/unparseable."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            seg = ast.get_source_segment(src, node)
+            return _code_tokens(seg) if seg is not None else None
+    return None
+
+
+def _scoped_equal(commit: str, path: str, repo: str | None,
+                  names: tuple[str, ...]) -> bool:
+    """True when every named function is token-identical between
+    ``commit`` and the working tree; a function missing or unparseable on
+    either side counts as changed."""
+    old = _git("show", f"{commit}:{path}", repo=repo)
+    if old is None:
+        return False
+    try:
+        with open(os.path.join(repo or _REPO, path)) as f:
+            new = f.read()
+    except OSError:
+        return False
+    for name in names:
+        old_t = _fn_tokens(old, name)
+        if old_t is None or old_t != _fn_tokens(new, name):
+            return False
+    return True
+
+
 def explicit_record_paths(record: dict, item: str | None = None) -> list[str] | None:
     """The measured file set a record can *specifically* claim, most
     specific source first: its own capture-time ``measured_paths``, the
@@ -290,13 +348,22 @@ def staleness(record: dict, repo: str | None = None, item: str | None = None) ->
     changed = changed_since(commit, paths, repo=repo)
     if changed is None:
         return {"stale": True, "reason": f"cannot verify commit {commit} (git unavailable)"}
-    really = [f for f in changed if not code_equivalent(commit, f, repo=repo)]
+    benign, really = [], []
+    for f in changed:
+        if code_equivalent(commit, f, repo=repo):
+            benign.append(f"{f} (comment-only)")
+            continue
+        scope = _protocol_scope(f, item or record.get("worklist_item"))
+        if scope and _scoped_equal(commit, f, repo, scope):
+            benign.append(f"{f} (protocol functions unchanged)")
+            continue
+        really.append(f)
     if really:
         return {"stale": True,
                 "reason": f"measured paths changed since {commit}: {', '.join(really[:4])}"
                           + (f" (+{len(really) - 4} more)" if len(really) > 4 else "")}
-    if changed:
+    if benign:
         return {"stale": False,
                 "reason": f"measured code unchanged since {commit} "
-                          f"(comment-only edits: {', '.join(changed[:4])})"}
+                          f"(benign edits: {', '.join(benign[:4])})"}
     return {"stale": False, "reason": f"measured paths unchanged since {commit}"}

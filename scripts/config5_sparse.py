@@ -152,9 +152,12 @@ def main() -> None:
         record = {
             **summary,
             # provenance stamp (commit + measured_paths) so staleness()
-            # can certify or flag this artifact like any persisted record
+            # can certify or flag this artifact like any persisted record;
+            # worklist_item scopes the worklist protocol file to this
+            # item's own child function (utils/provenance._protocol_scope)
             **provenance.head_stamp(
                 paths=provenance.ITEM_PATHS["config5_sparse"]),
+            "worklist_item": "config5_sparse",
             "jax_version": jax.__version__,
             "device": str(jax.devices()[0]),
             "host": platform_mod.node(),

@@ -892,7 +892,11 @@ def _merge(item: str, result: dict) -> None:
         # can't stale it later (VERDICT r4 Weak #1).
         prov = _provenance()
         stamp = ({} if "commit" in result
-                 else prov.head_stamp(paths=prov.ITEM_PATHS.get(item)))
+                 else {**prov.head_stamp(paths=prov.ITEM_PATHS.get(item)),
+                       # self-identify: staleness() scopes the worklist
+                       # protocol file to this item's child function even
+                       # when the caller can't pass item=
+                       "worklist_item": item})
         store[item] = {**stamp, **result,
                        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)

@@ -219,3 +219,57 @@ def test_bench_protocol_edit_stales_bench_record(tmp_repo):
     _commit_edit(tmp_repo, "bench.py", "protocol = 2\n", "bench v2")
     s = provenance.staleness(rec, repo=str(tmp_repo))
     assert s["stale"] and "bench.py" in s["reason"]
+
+
+def test_protocol_scoped_staleness(tmp_repo):
+    """Edits to a protocol file OUTSIDE its measurement functions don't
+    stale (bench.py → run_bench; tpu_worklist.py → shared helpers + the
+    record's own child function); edits INSIDE them do. This is what
+    keeps a mid-window fix to one failing worklist child from re-staling
+    every record captured minutes earlier in the same window."""
+    bench_v1 = ("def run_bench(a):\n    return a + 1\n"
+                "def report():\n    return 'v1'\n")
+    _commit_edit(tmp_repo, "bench.py", bench_v1, "bench v1")
+    rec = {"metric": "x (packed, soup, tpu)",
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    # reporting edit: record stays fresh, reason names the benign file
+    _commit_edit(tmp_repo, "bench.py",
+                 bench_v1.replace("'v1'", "'v2'"), "report change")
+    s = provenance.staleness(rec, repo=str(tmp_repo))
+    assert not s["stale"] and "protocol functions unchanged" in s["reason"]
+    # measurement edit: stale
+    _commit_edit(tmp_repo, "bench.py",
+                 bench_v1.replace("a + 1", "a + 2"), "protocol change")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+
+
+def test_worklist_scoping_needs_item_and_tracks_children(tmp_repo):
+    wl_v1 = ("def _bench_rate(x):\n    return x\n"
+             "def _sync_scalar(x):\n    return 1\n"
+             "def _device_equal(a, b):\n    return a == b\n"
+             "def child_pallas_band():\n    return 'band'\n"
+             "def child_elementary():\n    return 'elem'\n")
+    _commit_edit(tmp_repo, "scripts/tpu_worklist.py", wl_v1, "wl v1")
+    head = provenance.git_head(repo=str(tmp_repo))
+    rec = {"ok": True, "commit": head, "worklist_item": "pallas_band",
+           "measured_paths": ["scripts/tpu_worklist.py"]}
+    # another item's child changes: this record stays fresh (via its own
+    # embedded worklist_item — no item= passed)
+    _commit_edit(tmp_repo, "scripts/tpu_worklist.py",
+                 wl_v1.replace("'elem'", "'elem2'"), "other child")
+    s = provenance.staleness(rec, repo=str(tmp_repo))
+    assert not s["stale"], s
+    # same edit, but a record with NO known item: conservative full-file
+    anon = {"ok": True, "commit": head,
+            "measured_paths": ["scripts/tpu_worklist.py"]}
+    assert provenance.staleness(anon, repo=str(tmp_repo))["stale"]
+    # this record's own child changes: stale
+    _commit_edit(tmp_repo, "scripts/tpu_worklist.py",
+                 wl_v1.replace("'band'", "'band2'"), "own child")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
+    # a shared timing helper changes: stale for every item
+    _commit_edit(tmp_repo, "scripts/tpu_worklist.py", wl_v1, "restore")
+    rec2 = {**rec, "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, "scripts/tpu_worklist.py",
+                 wl_v1.replace("return x", "return x * 2"), "helper")
+    assert provenance.staleness(rec2, repo=str(tmp_repo))["stale"]
