@@ -502,7 +502,7 @@ class SparseEngineState:
             # XLA's CPU lowering the unrolled shrinking-slab window chain
             # loses more than the scan win — the persisted config-#5-shape
             # A/B (results/config5_sparse_8192_cpu_chunk_ab.json) measured
-            # g=8 at 750 gens/s vs 4784 unchunked (6.4x slower) at 8192²,
+            # g=8 at ~640 gens/s vs ~4790 unchunked (~7.5x slower) at 8192²,
             # the same non-fusion that makes the communication-avoiding
             # sharded runner CPU-slow. Built for the TPU, where the scan
             # was the measured 26 ms/gen bottleneck of config #5
