@@ -58,3 +58,50 @@ def test_packed_rate_floor_and_packing_advantage():
         f"packed ({packed_rate:.2e}/s) lost its advantage over dense "
         f"({dense_rate:.2e}/s)"
     )
+
+
+def test_family_rate_floors():
+    """Same catastrophe-only floors for the other families' serving paths:
+    Generations bit-planes (CPU-measured ~1.1e10/s at 1024²), dense-byte
+    LtL (the CPU serving path for binary LtL, ~5e8/s for bosco r=5), and
+    the sparse engine on the config-#5 gun shape (~4.8e3 gens/s at 8192²,
+    floored at 8192² scaled down to 2048²)."""
+    from gameoflifewithactors_tpu.models import seeds
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops.ltl import multi_step_ltl
+    from gameoflifewithactors_tpu.ops.packed_generations import (
+        multi_step_packed_generations,
+        pack_generations_for,
+    )
+    from gameoflifewithactors_tpu.ops.sparse import SparseEngineState
+
+    rng = np.random.default_rng(1)
+    brain = parse_any("brain")
+    g = rng.integers(0, 3, size=(SIDE, SIDE), dtype=np.uint8)
+    planes_rate = _rate(
+        lambda s, n: multi_step_packed_generations(
+            s, n, rule=brain, topology=Topology.TORUS),
+        pack_generations_for(jnp.asarray(g), brain))
+    assert planes_rate > 2e8, f"Generations planes collapsed: {planes_rate:.2e}/s"
+
+    bosco = parse_any("bosco")
+    gl = rng.integers(0, 2, size=(SIDE, SIDE), dtype=np.uint8)
+    ltl_rate = _rate(
+        lambda s, n: multi_step_ltl(s, n, rule=bosco, topology=Topology.TORUS),
+        jnp.asarray(gl))
+    assert ltl_rate > 1e7, f"dense LtL collapsed: {ltl_rate:.2e}/s"
+
+    side = 2048
+    state = SparseEngineState(
+        jnp.asarray(seeds.seeded_packed((side, side), "gosper_gun",
+                                        side // 2, side // 64)), CONWAY)
+    state.step(8)
+    state.active_tiles()  # sync
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state.step(GENS)
+        state.active_tiles()
+        best = max(best, GENS / (time.perf_counter() - t0))
+    # measured ~4.6e3 gens/s on this rig at 8192²; 100/s = catastrophe
+    assert best > 100, f"sparse engine collapsed: {best:.1f} gens/s"
