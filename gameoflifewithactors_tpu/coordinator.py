@@ -164,6 +164,7 @@ class GridCoordinator:
                     # would stall a live render/metrics loop's first tick
                     halo_bytes=self.engine.halo_bytes_per_gen(
                         source="model") * n or None,
+                    active_tiles=self.engine.active_tiles(),
                 )
             )
         self._notify()

@@ -879,6 +879,19 @@ class Engine:
                       + col_sends * dx * (fy // nx + 2 * dy) * 4)
         return total
 
+    def active_tiles(self) -> Optional[int]:
+        """Active-tile count of a sparse engine — the compute actually
+        paid per generation, the observability number that explains why a
+        65536² gun universe is cheap. None for non-sparse backends (and
+        for the per-device-flag sparse runner, whose wake granularity is
+        a whole shard, not tiles). Sharded tiled engines sum the
+        distributed activity map (one device reduction)."""
+        if self._sparse is not None:
+            return self._sparse.active_tiles()
+        if self._flags is not None and getattr(self, "_sparse_tiles", None):
+            return int(jnp.sum(self._flags))
+        return None
+
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total).
 

@@ -25,10 +25,11 @@ class StepMetrics:
     cell_updates_per_sec: float
     population: Optional[int] = None
     halo_bytes: Optional[int] = None   # est. interconnect bytes this record
+    active_tiles: Optional[int] = None  # sparse backends: tiles computed
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
-        for k in ("population", "halo_bytes"):
+        for k in ("population", "halo_bytes", "active_tiles"):
             if d[k] is None:
                 d.pop(k)
         return d

@@ -123,6 +123,24 @@ def test_coordinator_metrics():
     assert r.generation == 10 and r.generations_stepped == 5
     assert r.cell_updates_per_sec > 0
     assert r.population is not None
+    # non-sparse backend: no active-tile figure (and the dict omits it)
+    assert r.active_tiles is None and "active_tiles" not in r.to_dict()
+
+
+def test_coordinator_metrics_sparse_active_tiles():
+    # sparse backends surface the activity count — the number that
+    # explains why a huge mostly-dead universe is cheap
+    buf = BufferSink()
+    c = GridCoordinator((64, 256), "conway", seed="gosper_gun",
+                        backend="sparse",
+                        sparse_opts={"tile_rows": 16, "tile_words": 1},
+                        topology=Topology.DEAD,
+                        metrics=MetricsLogger(buf))
+    c.run(8, render_every=8)
+    r = buf.records[-1]
+    assert r.active_tiles is not None
+    assert 0 < r.active_tiles < (64 // 16) * (256 // 32)
+    assert r.to_dict()["active_tiles"] == r.active_tiles
 
 
 def test_scheduler_run_and_controls():
