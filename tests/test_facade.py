@@ -229,3 +229,19 @@ def test_auto_backend_resolution_off_tpu():
     assert e2.backend == "packed"
     with pytest.raises(ValueError, match="backend must be"):
         Engine(np.zeros((16, 32), np.uint8), "B3/S23", backend="warp")
+
+
+def test_ppm_sequence_subscriber(tmp_path):
+    # the RenderFrame-subscriber form writes the (possibly downsampled)
+    # frame view, numbered by generation, with the stem's extension
+    from gameoflifewithactors_tpu.utils.render import PpmSequenceWriter
+
+    c = GridCoordinator((16, 32), "conway", seed="glider",
+                        view_shape=(8, 16))
+    seq = PpmSequenceWriter(str(tmp_path / "f.ppm"))
+    c.subscribe(seq)
+    c.run(4, render_every=2)
+    assert [p.rsplit("_", 1)[1] for p in seq.paths] == [
+        "000002.ppm", "000004.ppm"]
+    data = (tmp_path / "f_000002.ppm").read_bytes()
+    assert data.startswith(b"P6\n16 8\n255\n")   # the downsampled view
