@@ -236,8 +236,10 @@ def _protocol_scope(path: str, item: str | None) -> tuple[str, ...] | None:
 
 
 def _fn_tokens(src: str, name: str) -> list | None:
-    """Token stream of top-level function ``name`` in ``src`` (comments/
-    blank lines dropped), or None when absent/unparseable."""
+    """Comparison key of top-level function ``name`` in ``src``: its token
+    stream (comments/blank lines dropped) plus its decorator ASTs —
+    get_source_segment excludes decorators, and a decorator swap changes
+    behavior as surely as a body edit. None when absent/unparseable."""
     try:
         tree = ast.parse(src)
     except SyntaxError:
@@ -246,7 +248,12 @@ def _fn_tokens(src: str, name: str) -> list | None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and node.name == name:
             seg = ast.get_source_segment(src, node)
-            return _code_tokens(seg) if seg is not None else None
+            if seg is None:
+                return None
+            toks = _code_tokens(seg)
+            if toks is None:
+                return None
+            return [tuple(ast.dump(d) for d in node.decorator_list), *toks]
     return None
 
 

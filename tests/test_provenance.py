@@ -273,3 +273,15 @@ def test_worklist_scoping_needs_item_and_tracks_children(tmp_repo):
     _commit_edit(tmp_repo, "scripts/tpu_worklist.py",
                  wl_v1.replace("return x", "return x * 2"), "helper")
     assert provenance.staleness(rec2, repo=str(tmp_repo))["stale"]
+
+
+def test_protocol_scope_sees_decorator_changes(tmp_repo):
+    # get_source_segment excludes decorators; a decorator swap on a
+    # protocol function must still stale (it changes behavior)
+    v1 = "def deco(f):\n    return f\n\n@deco\ndef run_bench(a):\n    return a\n"
+    _commit_edit(tmp_repo, "bench.py", v1, "v1")
+    rec = {"metric": "x (packed, soup, tpu)",
+           "commit": provenance.git_head(repo=str(tmp_repo))}
+    _commit_edit(tmp_repo, "bench.py",
+                 v1.replace("@deco\ndef run_bench", "def run_bench"), "un-decorate")
+    assert provenance.staleness(rec, repo=str(tmp_repo))["stale"]
