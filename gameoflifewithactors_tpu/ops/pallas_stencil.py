@@ -365,6 +365,15 @@ def _ltl_pallas_call(rule, topology: Topology, shape, bh: int, g: int,
             pltpu.VMEM((2, L, Wp), jnp.uint32),      # revolving slab buffers
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
+        # Mosaic's default 16 MiB scoped-vmem cap rejects the bit-sliced
+        # window sum's live count planes at bench shapes (measured on
+        # chip: 17.74 MB scoped at bosco r=5, g=8, bh=512, Wp=256 —
+        # results/tpu_worklist.json ltl_pallas @700b444). v4+ cores have
+        # 128 MiB VMEM; raise the cap for this kernel only (and only on
+        # such cores) and gate block sizes on _LTL_VMEM_BUDGET below it.
+        compiler_params=(pltpu.CompilerParams(vmem_limit_bytes=lim)
+                         if not interpret and (lim := _ltl_vmem_limit())
+                         else None),
         interpret=interpret,
     )
 
@@ -398,34 +407,88 @@ def make_ltl_pallas_slab_step(
     contract; shard_map callers need ``check_vma=False``."""
     He, Wp = ext_shape
     g = int(gens)
-    hr = rule.radius * g
+    r = rule.radius
+    hr = r * g
+    vmem_model = _ltl_vmem_model(r)
+    budget = _ltl_vmem_budget()
     bh = block_rows or _pick_bh(He, native=not interpret, at_least=hr,
-                                g=hr, Wp=Wp, vmem_bytes=_ltl_vmem_bytes)
+                                g=hr, Wp=Wp, vmem_bytes=vmem_model,
+                                budget=budget)
     if hr > bh:
         raise ValueError(
             f"LtL slab kernel needs radius*gens ({hr}) <= block_rows ({bh})")
     _validate_slab(He, bh, hr, interpret, Wp=Wp)
-    if not interpret and _ltl_vmem_bytes(bh, hr, Wp) > _VMEM_BUDGET:
+    if not interpret and vmem_model(bh, hr, Wp) > budget:
         # the generic check models the binary kernel; the bit-sliced box
         # sum's count planes need the larger LtL budget
         raise ValueError(
-            f"LtL kernel VMEM footprint {_ltl_vmem_bytes(bh, hr, Wp)} bytes "
+            f"LtL kernel VMEM footprint {vmem_model(bh, hr, Wp)} bytes "
             f"(block_rows={bh}, radius*gens={hr}, width {Wp * 32} cells) "
-            f"exceeds the {_VMEM_BUDGET >> 20} MiB budget; use smaller "
+            f"exceeds the {budget >> 20} MiB budget; use smaller "
             "block_rows or a shallower exchange")
     return _ltl_pallas_call(rule, topology, (He, Wp), bh, g, interpret,
                             slab_mode=True, dead_band=dead_band)
 
 
-# the bit-sliced window sum (box or plane-truncated diamond) holds up to
-# ~8 count planes of the slab alongside the revolving buffers; budget
-# them (vs the 3x3 kernel's lone carry network)
-_LTL_VMEM_PLANES = 8
+# Scoped-vmem cap passed to Mosaic for the LtL kernel on cores with
+# 128 MiB physical VMEM (v4 and later; v2/v3 cores have 16 MiB and keep
+# Mosaic's default cap — see _ltl_vmem_limit); _LTL_VMEM_BUDGET gates
+# block picking with headroom under it. The budget assumes a v4+ core —
+# the framework's stated target (BASELINE.json: v5e) — so ltl_supported
+# on_tpu=True answers for that generation.
+_LTL_VMEM_LIMIT = 64 * 1024 * 1024
+_LTL_VMEM_BUDGET = 48 * 1024 * 1024
 
 
-def _ltl_vmem_bytes(bh: int, hr: int, Wp: int) -> int:
+def _ltl_vmem_planes(r: int) -> int:
+    """Live slab-sized temporaries of the bit-sliced window sum (count
+    planes + sliding partials), alongside the revolving buffers.
+    Calibrated from Mosaic's measured scoped allocation at r=5 box
+    (17.74 MB at g=8, bh=512, Wp=256 → 27.5 planes-equivalent; the prior
+    flat estimate of 8 under-predicted 2.6×) and extrapolated linearly in
+    the (2r+1) window rows the sliding sum holds — a single calibration
+    point, so the scaling is deliberately the conservative direction for
+    r>5 (code-review r5: MAX_RADIUS=7 rules share this model). Floored so
+    small radii never under-reserve vs the old estimate."""
+    return max(10, -(-28 * (2 * r + 1) // 11))
+
+
+def _ltl_vmem_bytes(bh: int, hr: int, Wp: int, *, r: int) -> int:
     L = bh + 2 * hr
-    return ((2 + _LTL_VMEM_PLANES) * L + 2 * bh) * Wp * 4
+    return ((2 + _ltl_vmem_planes(r)) * L + 2 * bh) * Wp * 4
+
+
+def _ltl_vmem_limit() -> int:
+    """The scoped-vmem cap to request for the compiling device: raised on
+    v4+ cores (128 MiB physical), 0 (= keep Mosaic's default) on older or
+    unrecognized cores where 64 MiB exceeds physical VMEM."""
+    import re
+
+    kind = jax.devices()[0].device_kind.lower()
+    # 'tpu v5 lite' / 'TPU v4' / bare 'tpu7x'-style kinds all carry the
+    # generation digit; only v2/v3 (16 MiB cores) keep the default cap
+    m = re.search(r"(?:v|tpu)\s*(\d+)", kind)
+    return _LTL_VMEM_LIMIT if m and int(m.group(1)) >= 4 else 0
+
+
+def _ltl_vmem_budget() -> int:
+    """Block-picking budget matching the cap :func:`_ltl_vmem_limit` will
+    request, so ``ltl_supported`` never admits a shape Mosaic then rejects
+    (code-review r5): conservative when the local device is a pre-v4 TPU
+    (16 MiB cores keep the default cap); the raised budget on v4+ cores
+    and on non-TPU hosts, which predict for the v4+ target the framework
+    builds for (BASELINE.json: v5e) — the CPU test rig and the fake-device
+    dryrun must answer for that target, not for the host."""
+    d = jax.devices()[0]
+    if d.platform == "tpu" and not _ltl_vmem_limit():
+        return _VMEM_BUDGET
+    return _LTL_VMEM_BUDGET
+
+
+def _ltl_vmem_model(r: int):
+    """The LtL VMEM model with the rule's radius bound — the shared
+    adapter every ``_pick_bh`` call site passes as ``vmem_bytes``."""
+    return lambda bh, hr, Wp: _ltl_vmem_bytes(bh, hr, Wp, r=r)
 
 
 def ltl_supported(shape, rule, *, on_tpu: bool,
@@ -440,12 +503,13 @@ def ltl_supported(shape, rule, *, on_tpu: bool,
         return False
     H, Wp = shape
     g = gens_per_call or DEFAULT_GENS_PER_CALL
-    hr = rule.radius * g
+    r = rule.radius
+    hr = r * g
     if on_tpu and (Wp % 128 or H % 8 or hr % 8):
         return False
     try:
         _pick_bh(H, native=on_tpu, at_least=hr, g=hr, Wp=Wp,
-                 vmem_bytes=_ltl_vmem_bytes)
+                 vmem_bytes=_ltl_vmem_model(r), budget=_ltl_vmem_budget())
     except ValueError:
         return False
     return True
@@ -469,9 +533,11 @@ def make_ltl_pallas_step(
     (box or diamond)."""
     H, Wp = shape
     g = gens_per_call or DEFAULT_GENS_PER_CALL
-    hr = rule.radius * g
+    r = rule.radius
+    hr = r * g
     bh = block_rows or _pick_bh(H, native=not interpret, at_least=hr,
-                                g=hr, Wp=Wp, vmem_bytes=_ltl_vmem_bytes)
+                                g=hr, Wp=Wp, vmem_bytes=_ltl_vmem_model(r),
+                                budget=_ltl_vmem_budget())
     if g < 1 or hr > bh:
         raise ValueError(
             f"LtL kernel needs radius*gens ({hr}) <= block_rows ({bh})")
@@ -485,6 +551,15 @@ def make_ltl_pallas_step(
         raise ValueError(
             f"native TPU kernel needs the packed width ({Wp} words) to be "
             "a multiple of 128 words (lane tiling)")
+    if not interpret and _ltl_vmem_bytes(bh, hr, Wp, r=r) > _ltl_vmem_budget():
+        # explicit block_rows bypasses _pick_bh — guard here too, so an
+        # oversized block raises this ValueError instead of the opaque
+        # Mosaic scoped-vmem error (the slab twin has the same check)
+        raise ValueError(
+            f"LtL kernel VMEM footprint {_ltl_vmem_bytes(bh, hr, Wp, r=r)} "
+            f"bytes (block_rows={bh}, radius*gens={hr}, width {Wp * 32} "
+            f"cells) exceeds the {_ltl_vmem_budget() >> 20} MiB budget; "
+            "use smaller block_rows or a shallower exchange")
     return _build_ltl_runner(rule, topology, (H, Wp), bh, g, interpret,
                              donate), g
 
@@ -724,7 +799,7 @@ def _vmem_bytes(bh: int, g: int, Wp: int) -> int:
 
 def _pick_bh(H: int, native: bool = False, at_least: int = 1,
              g: int = DEFAULT_GENS_PER_CALL, Wp: int = 0,
-             vmem_bytes=None) -> int:
+             vmem_bytes=None, budget: int = 0) -> int:
     """Largest block height <= max(DEFAULT_BLOCK_ROWS, at_least) dividing H
     (8-aligned when targeting real Mosaic, see the multiple_of hints in the
     kernel), >= ``at_least`` (the slab path's DMA scheme needs blocks at
@@ -732,8 +807,10 @@ def _pick_bh(H: int, native: bool = False, at_least: int = 1,
     fitting the VMEM budget under ``vmem_bytes(bh, g, Wp)`` (the
     double-buffered model by default, the bit-sliced LtL model via
     _ltl_vmem_bytes; wide grids get shorter blocks instead of a Mosaic
-    allocation failure)."""
+    allocation failure). ``budget`` overrides the 14 MiB default —
+    the LtL kernel budgets against its raised scoped-vmem cap."""
     vmem_bytes = vmem_bytes or _vmem_bytes
+    budget = budget or _VMEM_BUDGET
     bh = min(max(DEFAULT_BLOCK_ROWS, at_least), H)
     step = 1
     if native:
@@ -741,13 +818,13 @@ def _pick_bh(H: int, native: bool = False, at_least: int = 1,
         step = 8
     floor = max(at_least, 1)
     while bh >= floor and (
-            H % bh or (Wp and vmem_bytes(bh, g, Wp) > _VMEM_BUDGET)):
+            H % bh or (Wp and vmem_bytes(bh, g, Wp) > budget)):
         bh -= step
     if bh < floor:
         raise ValueError(
             f"no usable block height for grid height {H}"
             + (f" with blocks >= {at_least} rows" if at_least > 1 else "")
-            + (f" within the {_VMEM_BUDGET >> 20} MiB VMEM budget at "
+            + (f" within the {budget >> 20} MiB VMEM budget at "
                f"width {Wp * 32} cells" if Wp else ""))
     return bh
 
