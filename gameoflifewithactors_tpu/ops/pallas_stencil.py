@@ -442,15 +442,18 @@ _LTL_VMEM_BUDGET = 48 * 1024 * 1024
 
 def _ltl_vmem_planes(r: int) -> int:
     """Live slab-sized temporaries of the bit-sliced window sum (count
-    planes + sliding partials), alongside the revolving buffers.
-    Calibrated from Mosaic's measured scoped allocation at r=5 box
-    (17.74 MB at g=8, bh=512, Wp=256 → 27.5 planes-equivalent; the prior
-    flat estimate of 8 under-predicted 2.6×) and extrapolated linearly in
-    the (2r+1) window rows the sliding sum holds — a single calibration
-    point, so the scaling is deliberately the conservative direction for
-    r>5 (code-review r5: MAX_RADIUS=7 rules share this model). Floored so
-    small radii never under-reserve vs the old estimate."""
-    return max(10, -(-28 * (2 * r + 1) // 11))
+    planes + sliding partials), BESIDE the two revolving buffers
+    _ltl_vmem_bytes adds separately. Calibrated from Mosaic's measured
+    scoped allocation at r=5 box (17.74 MiB = 18,601,738 bytes at g=8,
+    bh=512, Wp=256 — Mosaic prints binary MiB; its default cap shows as
+    "16.00M" — → 26.96 count planes once the 2 revolving L-planes are
+    taken out; the prior flat estimate of 8 under-predicted ~3×) and
+    extrapolated linearly in the (2r+1) window rows the sliding sum
+    holds — a single calibration point, so the scaling is deliberately
+    the conservative direction for r>5 (code-review r5: MAX_RADIUS=7
+    rules share this model). Floored so small radii never under-reserve
+    vs the old estimate."""
+    return max(10, -(-27 * (2 * r + 1) // 11))
 
 
 def _ltl_vmem_bytes(bh: int, hr: int, Wp: int, *, r: int) -> int:
@@ -459,30 +462,31 @@ def _ltl_vmem_bytes(bh: int, hr: int, Wp: int, *, r: int) -> int:
 
 
 def _ltl_vmem_limit() -> int:
-    """The scoped-vmem cap to request for the compiling device: raised on
-    v4+ cores (128 MiB physical), 0 (= keep Mosaic's default) on older or
-    unrecognized cores where 64 MiB exceeds physical VMEM."""
+    """The scoped-vmem cap to request from Mosaic: raised on v4+ cores
+    (128 MiB physical) and on non-TPU hosts, which lower for the v4+
+    target the framework builds for (BASELINE.json: v5e) — the CPU test
+    rig, the fake-device dryrun, and any AOT cross-lowering must answer
+    for that target, not for the host; 0 (= keep Mosaic's default) only
+    on pre-v4 / unrecognized TPU cores where 64 MiB exceeds physical
+    VMEM. The single decision point: :func:`_ltl_vmem_budget` keys off
+    this same value, so block picking can never admit a shape the
+    compile-time cap then rejects (code-review r5)."""
     import re
 
-    kind = jax.devices()[0].device_kind.lower()
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return _LTL_VMEM_LIMIT
     # 'tpu v5 lite' / 'TPU v4' / bare 'tpu7x'-style kinds all carry the
     # generation digit; only v2/v3 (16 MiB cores) keep the default cap
-    m = re.search(r"(?:v|tpu)\s*(\d+)", kind)
+    m = re.search(r"(?:v|tpu)\s*(\d+)", d.device_kind.lower())
     return _LTL_VMEM_LIMIT if m and int(m.group(1)) >= 4 else 0
 
 
 def _ltl_vmem_budget() -> int:
-    """Block-picking budget matching the cap :func:`_ltl_vmem_limit` will
-    request, so ``ltl_supported`` never admits a shape Mosaic then rejects
-    (code-review r5): conservative when the local device is a pre-v4 TPU
-    (16 MiB cores keep the default cap); the raised budget on v4+ cores
-    and on non-TPU hosts, which predict for the v4+ target the framework
-    builds for (BASELINE.json: v5e) — the CPU test rig and the fake-device
-    dryrun must answer for that target, not for the host."""
-    d = jax.devices()[0]
-    if d.platform == "tpu" and not _ltl_vmem_limit():
-        return _VMEM_BUDGET
-    return _LTL_VMEM_BUDGET
+    """Block-picking budget with headroom under the cap
+    :func:`_ltl_vmem_limit` will request; conservative exactly when the
+    cap stays at Mosaic's default."""
+    return _LTL_VMEM_BUDGET if _ltl_vmem_limit() else _VMEM_BUDGET
 
 
 def _ltl_vmem_model(r: int):
@@ -551,15 +555,16 @@ def make_ltl_pallas_step(
         raise ValueError(
             f"native TPU kernel needs the packed width ({Wp} words) to be "
             "a multiple of 128 words (lane tiling)")
-    if not interpret and _ltl_vmem_bytes(bh, hr, Wp, r=r) > _ltl_vmem_budget():
+    fp, budget = _ltl_vmem_bytes(bh, hr, Wp, r=r), _ltl_vmem_budget()
+    if not interpret and fp > budget:
         # explicit block_rows bypasses _pick_bh — guard here too, so an
         # oversized block raises this ValueError instead of the opaque
         # Mosaic scoped-vmem error (the slab twin has the same check)
         raise ValueError(
-            f"LtL kernel VMEM footprint {_ltl_vmem_bytes(bh, hr, Wp, r=r)} "
-            f"bytes (block_rows={bh}, radius*gens={hr}, width {Wp * 32} "
-            f"cells) exceeds the {_ltl_vmem_budget() >> 20} MiB budget; "
-            "use smaller block_rows or a shallower exchange")
+            f"LtL kernel VMEM footprint {fp} bytes (block_rows={bh}, "
+            f"radius*gens={hr}, width {Wp * 32} cells) exceeds the "
+            f"{budget >> 20} MiB budget; use smaller block_rows or a "
+            "shallower exchange")
     return _build_ltl_runner(rule, topology, (H, Wp), bh, g, interpret,
                              donate), g
 

@@ -351,3 +351,57 @@ class TestLtLKernel:
         assert any("falling back" in str(c.message) for c in caught)
         short.step(2)                     # must run on the fallback path
         assert short.population() == 0
+
+
+def test_ltl_vmem_gate_calibration_and_guards(monkeypatch):
+    """The r5 scoped-VMEM rework (first native LtL compile, worklist
+    ltl_pallas @700b444: Mosaic measured 17.74 MB scoped against its
+    16 MiB default cap at bosco g=8, bh=512, Wp=256): the radius-scaled
+    model, the device-gated cap, and the explicit-block guard."""
+    import jax
+
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops import pallas_stencil as ps
+
+    # calibration point (r=5 box -> 26.96 count planes measured beside
+    # the 2 revolving buffers; Mosaic's "17.74M" is binary MiB =
+    # 18,601,738 bytes) and the conservative linear extrapolation over
+    # window rows, floored
+    assert ps._ltl_vmem_planes(5) == 27
+    assert ps._ltl_vmem_planes(7) == 37
+    assert ps._ltl_vmem_planes(1) == 10
+    planes = [ps._ltl_vmem_planes(r) for r in range(1, 8)]
+    assert planes == sorted(planes)
+    # the model at the measured failure point must cover the measurement
+    assert ps._ltl_vmem_bytes(512, 40, 256, r=5) >= 18_601_738
+
+    # the previously failing bench shape stays supported for the v4+
+    # target (non-TPU hosts predict for it; conftest forces CPU here)
+    bosco = parse_any("bosco")
+    assert ps.ltl_supported((1024, 256), bosco, on_tpu=True)
+    assert ps._ltl_vmem_budget() == ps._LTL_VMEM_BUDGET
+
+    # device gating of the scoped cap by generation digit
+    class _Dev:
+        def __init__(self, kind, platform="tpu"):
+            self.device_kind = kind
+            self.platform = platform
+
+    for kind, want_raised in (("TPU v3", False), ("TPU v2", False),
+                              ("TPU v4", True), ("TPU v5 lite", True),
+                              ("TPU7x", True), ("weird-core", False)):
+        monkeypatch.setattr(jax, "devices", lambda k=kind: [_Dev(k)])
+        got = ps._ltl_vmem_limit()
+        assert bool(got) == want_raised, kind
+        # the block-picking budget must match the cap the compile will
+        # request, so ltl_supported never admits what Mosaic rejects
+        assert ps._ltl_vmem_budget() == (
+            ps._LTL_VMEM_BUDGET if want_raised else ps._VMEM_BUDGET), kind
+    monkeypatch.undo()
+
+    # explicit block_rows bypasses _pick_bh: the footprint guard must
+    # raise a clean ValueError, not hand Mosaic an over-cap allocation
+    with pytest.raises(ValueError, match="VMEM footprint"):
+        ps.make_ltl_pallas_step(bosco, Topology.TORUS, (8192, 4096),
+                                block_rows=8192, gens_per_call=8,
+                                interpret=False)
