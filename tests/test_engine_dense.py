@@ -126,8 +126,13 @@ def test_auto_resolution_tpu_branches(monkeypatch):
     assert host._resolve_auto(np.zeros((32, 4096), np.uint8), m24,
                               Topology.TORUS) == "packed"
 
-    # LtL on TPU: bit-sliced packed for binary (both neighborhoods),
-    # dense for multi-state decay
+    # LtL on TPU: bit-sliced packed for binary (both neighborhoods);
+    # multi-state decay routes from the on-chip ltl_planes record —
+    # captured 2026-08-02 (planes 7.9e10 vs dense 6.7e9 cell-updates/s,
+    # results/tpu_worklist.json), so auto picks the plane stack; absent
+    # a usable capture it must stay dense (never route unmeasured)
+    from gameoflifewithactors_tpu import engine as engine_mod
+
     bosco = Engine(np.zeros((64, 64), np.uint8), "bosco", backend="dense")
     assert bosco._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
                                Topology.TORUS) == "packed"
@@ -137,5 +142,10 @@ def test_auto_resolution_tpu_branches(monkeypatch):
                                  Topology.TORUS) == "packed"
     multi = Engine(np.zeros((64, 64), np.uint8),
                    parse_any("R2,C4,M1,S3..8,B5..9"), backend="dense")
+    monkeypatch.setattr(engine_mod, "_ltl_planes_tpu_rates",
+                        lambda: {"planes": 7.9e10, "dense": 6.7e9})
+    assert multi._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
+                               Topology.TORUS) == "packed"
+    monkeypatch.setattr(engine_mod, "_ltl_planes_tpu_rates", lambda: None)
     assert multi._resolve_auto(np.zeros((4096, 4096), np.uint8), None,
                                Topology.TORUS) == "dense"
