@@ -81,6 +81,13 @@ def _parse(argv):
                     help="print the provenance scoreboard of every persisted "
                          "record (bench + worklist) and exit; needs no TPU "
                          "and never imports jax")
+    ap.add_argument("--telemetry-out", default=None, metavar="PATH",
+                    help="write a RunReport JSON (host spans, jit compile "
+                         "events, per-rep timings, stall events) for the "
+                         "measured run; inspect with `python -m "
+                         "gameoflifewithactors_tpu report PATH`. Written by "
+                         "the measuring child, so a fresh measurement is "
+                         "required (a persisted-record fallback writes none)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -246,6 +253,30 @@ def run_bench(args) -> None:
         multi_step_pallas,
     )
     from gameoflifewithactors_tpu.ops.stencil import Topology, multi_step
+
+    import contextlib
+
+    telem = None
+    if args.telemetry_out:
+        from gameoflifewithactors_tpu.obs import begin_run_telemetry
+
+        # an in-process stall event (naming the last-completed span)
+        # escapes on stderr BEFORE the parent's subprocess watchdog kills
+        # a wedged child — the diagnostics the wedged-probe runs never had
+        telem = begin_run_telemetry(stall_deadline=float(
+            os.environ.get("BENCH_STALL_DEADLINE_S", "60")))
+
+    def _span(name, **attrs):
+        if telem is None:
+            return contextlib.nullcontext()
+        from gameoflifewithactors_tpu.obs import span
+
+        return span(name, **attrs)
+
+    def _watched(label):
+        if telem is None or telem.watchdog is None:
+            return contextlib.nullcontext()
+        return telem.watchdog.watch(label)
 
     platform = jax.devices()[0].platform
     side = args.size or (16384 if platform != "cpu" else 4096)
@@ -432,8 +463,9 @@ def run_bench(args) -> None:
 
     # warmup: compile + a few generations (>= the pallas temporal depth, so
     # the kernel itself compiles here, not inside the autotune timing)
-    state = run(state, 10)
-    sync(state)
+    with _span("bench.warmup", backend=args.backend), _watched("bench.warmup"):
+        state = run(state, 10)
+        sync(state)
 
     gens = args.gens
     if gens is None:
@@ -444,8 +476,9 @@ def run_bench(args) -> None:
         # ~7x under the chip's sustained rate), hence 64 gens and a 16384
         # cap rather than the earlier 10 and 2000.
         t0 = time.perf_counter()
-        state = run(state, 64)
-        sync(state)
+        with _span("bench.autotune"), _watched("bench.autotune"):
+            state = run(state, 64)
+            sync(state)
         per_gen = (time.perf_counter() - t0) / 64
         gens = max(10, min(16384, int(4.0 / max(per_gen, 1e-7))))
 
@@ -453,8 +486,9 @@ def run_bench(args) -> None:
     best = 0.0
     for rep in range(args.repeats):
         t0 = time.perf_counter()
-        state = run(state, gens)
-        sync(state)
+        with _span("bench.rep", rep=rep, gens=gens), _watched(f"bench.rep{rep}"):
+            state = run(state, gens)
+            sync(state)
         dt = time.perf_counter() - t0
         best = max(best, cells * gens / dt)
         if rep == 0 and args.gens is None and dt < 2.0:
@@ -473,6 +507,16 @@ def run_bench(args) -> None:
         "unit": "cell-updates/sec",
         "vs_baseline": best / NORTH_STAR_TARGET,
     }))
+    if telem is not None:
+        run_report = telem.finish(
+            config={"bench": True, "side": side, "rule": rule.notation,
+                    "backend": args.backend, "platform": platform,
+                    "gens_per_rep": gens, "repeats": args.repeats,
+                    "best_cell_updates_per_sec": best},
+            halo_bytes={"model_per_gen": 0, "measured_per_gen": None})
+        run_report.save(args.telemetry_out)
+        sys.stderr.write(
+            f"telemetry report written: {args.telemetry_out}\n")
 
 
 def main() -> None:

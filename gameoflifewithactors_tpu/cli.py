@@ -38,7 +38,8 @@ def _run_elementary(cfg, args, rule) -> int:
     for flag, value in (("--checkpoint", cfg.checkpoint),
                         ("--metrics", cfg.metrics), ("--mesh", cfg.mesh),
                         ("--ppm-every", cfg.ppm_every or None),
-                        ("--save-rle", cfg.save_rle)):
+                        ("--save-rle", cfg.save_rle),
+                        ("--telemetry-out", cfg.telemetry_out)):
         if value is not None:
             raise SystemExit(
                 f"{flag} is not supported for 1D W-rules (the spacetime "
@@ -108,7 +109,35 @@ def _list_registries() -> int:
     return 0
 
 
+def _report_cmd(argv: Sequence[str]) -> int:
+    """``python -m gameoflifewithactors_tpu report run.json``: the human
+    face of a RunReport written by ``--telemetry-out`` (or bench.py) —
+    phases, compiles, rates, stalls, device duty cycle. Pure file
+    reading: builds no engine and never touches the device."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="gameoflifewithactors_tpu report",
+        description="summarize a RunReport JSON (--telemetry-out artifact)")
+    ap.add_argument("path", help="RunReport JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the raw JSON (validated) instead")
+    args = ap.parse_args(argv)
+    from .obs.report import RunReport
+
+    rep = RunReport.load(args.path)
+    if args.json:
+        print(rep.to_json())
+    else:
+        print("\n".join(rep.summary_lines()))
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "report":
+        return _report_cmd(argv[1:])
+
     from .utils.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
@@ -125,6 +154,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _run_elementary(cfg, args, parse_any(cfg.rule))
 
     coordinator, scheduler = cfg.build()
+
+    telem = None
+    if cfg.telemetry_out:
+        from .obs import begin_run_telemetry
+
+        # session starts AFTER build: construction-time compiles (e.g. a
+        # resume) would be attributed to no tick, but the watchdog must
+        # not watch interactive seed parsing either — run time only
+        telem = begin_run_telemetry(
+            stall_deadline=cfg.stall_deadline or 60.0)
+        telem.attach(coordinator)
 
     if args.render == "live":
         coordinator.subscribe(ConsoleRenderer())
@@ -194,6 +234,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         path = ckpt_lib.save(coordinator.engine, cfg.checkpoint)
         print(f"checkpoint written: {path}", file=sys.stderr)
+
+    if telem is not None:
+        report = telem.finish(
+            engine=coordinator.engine,
+            config={"steps": cfg.steps, "argv": list(argv)})
+        report.save(cfg.telemetry_out)
+        print(f"telemetry report written: {cfg.telemetry_out}",
+              file=sys.stderr)
 
     coordinator.engine.block_until_ready()
     return 0

@@ -46,6 +46,8 @@ class SimulationConfig:
     ppm: Optional[str] = None               # final-frame / spacetime PPM path
     ppm_every: int = 0                      # full-res frame sequence cadence
     save_rle: Optional[str] = None          # final state as RLE (binary rules)
+    telemetry_out: Optional[str] = None     # RunReport JSON path (obs/)
+    stall_deadline: Optional[float] = None  # watchdog deadline seconds
 
     # -- assembly ------------------------------------------------------------
 
@@ -215,6 +217,16 @@ def make_parser() -> argparse.ArgumentParser:
                    help="write the final state as standard RLE (Golly-"
                         "compatible; binary rules only — round-trips with "
                         "--seed @file.rle)")
+    p.add_argument("--telemetry-out", default=None, metavar="PATH",
+                   help="write a RunReport JSON here at end of run: host "
+                        "spans (dispatch/sync/readback), jit compile "
+                        "events, StepMetrics, halo-byte figures, stalls "
+                        "(see README 'Observability'; inspect with the "
+                        "'report' subcommand)")
+    p.add_argument("--stall-deadline", type=float, default=None, metavar="S",
+                   help="with --telemetry-out: flag any tick exceeding S "
+                        "seconds, naming the last-completed span "
+                        "(default 60; the wedged-TPU diagnostic)")
     p.add_argument("--resume", default=None, metavar="PATH",
                    help="resume from a checkpoint (the checkpoint's grid/rule/"
                         "seed/topology win; --grid/--rule/--seed/--topology are ignored)")
@@ -253,5 +265,7 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         ppm=args.ppm,
         ppm_every=args.ppm_every,
         save_rle=args.save_rle,
+        telemetry_out=args.telemetry_out,
+        stall_deadline=args.stall_deadline,
     )
     return cfg, args

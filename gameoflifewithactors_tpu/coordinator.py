@@ -32,6 +32,9 @@ from jax.sharding import Mesh
 from .engine import Engine
 from .models import seeds as seeds_lib
 from .models.rules import Rule, parse_rule
+from .obs import compile as obs_compile
+from .obs import spans as obs_spans
+from .obs import watchdog as obs_watchdog
 from .ops.stencil import Topology
 from .utils.metrics import MetricsLogger, StepMetrics
 
@@ -144,30 +147,51 @@ class GridCoordinator:
     def tick(self, n: int = 1) -> None:
         """Advance n generations and notify subscribers once (the reference
         notifies its renderer per generation; batching is the knob that
-        keeps readback off the device hot loop)."""
+        keeps readback off the device hot loop).
+
+        When a stall watchdog is armed (obs.watchdog.arm), the whole tick
+        runs under its watch so a wedged dispatch/sync is flagged — with
+        the last-completed span named — while still stuck."""
+        wd = obs_watchdog.active_watchdog()
+        if wd is not None:
+            with wd.watch(f"tick@gen{self.generation}+{n}"):
+                self._tick(n)
+        else:
+            self._tick(n)
+
+    def _tick(self, n: int) -> None:
         t0 = time.perf_counter()
-        self.engine.step(n)
-        if self.metrics is not None:
-            self.engine.block_until_ready()
-            dt = time.perf_counter() - t0
-            cells = self.shape[0] * self.shape[1] * n
-            self.metrics.log(
-                StepMetrics(
-                    generation=self.generation,
-                    generations_stepped=n,
-                    wall_seconds=dt,
-                    cell_updates_per_sec=cells / dt if dt > 0 else float("inf"),
-                    population=self.population() if self.track_population else None,
-                    # the arithmetic model (pinned == the HLO figure in
-                    # tests/test_halo_bytes.py): the default 'auto' source
-                    # compiles a one-generation step on first use, which
-                    # would stall a live render/metrics loop's first tick
-                    halo_bytes=self.engine.halo_bytes_per_gen(
-                        source="model") * n or None,
-                    active_tiles=self.engine.active_tiles(),
+        with obs_spans.span("coordinator.tick", generations=n):
+            self.engine.step(n)
+            if self.metrics is not None:
+                self.engine.block_until_ready()
+                t1 = time.perf_counter()
+                # compiles that completed inside this tick (ops/_jit.py
+                # tracking): reported separately so wall_seconds — and the
+                # rate derived from it — describe *stepping*, not the
+                # one-off XLA compile the first tick happens to pay
+                compile_s = obs_compile.COMPILE_LOG.compile_seconds_between(
+                    t0, t1)
+                dt = max(t1 - t0 - compile_s, 1e-9)
+                cells = self.shape[0] * self.shape[1] * n
+                self.metrics.log(
+                    StepMetrics(
+                        generation=self.generation,
+                        generations_stepped=n,
+                        wall_seconds=dt,
+                        cell_updates_per_sec=cells / dt,
+                        population=self.population() if self.track_population else None,
+                        # the arithmetic model (pinned == the HLO figure in
+                        # tests/test_halo_bytes.py): the default 'auto' source
+                        # compiles a one-generation step on first use, which
+                        # would stall a live render/metrics loop's first tick
+                        halo_bytes=self.engine.halo_bytes_per_gen(
+                            source="model") * n or None,
+                        active_tiles=self.engine.active_tiles(),
+                        compile_seconds=compile_s or None,
+                    )
                 )
-            )
-        self._notify()
+            self._notify()
 
     def run(self, generations: int, *, render_every: int = 0) -> None:
         """Run ``generations`` generations; if render_every > 0, surface a
@@ -201,6 +225,10 @@ class GridCoordinator:
     def _notify(self) -> None:
         if not self._subscribers:
             return
-        frame = self.current_frame()
-        for fn in list(self._subscribers):
-            fn(frame)
+        # subscriber time (renderers, PPM writers) is host time the tick
+        # pays; its own span keeps it separable from dispatch/sync
+        with obs_spans.span("coordinator.notify",
+                            subscribers=len(self._subscribers)):
+            frame = self.current_frame()
+            for fn in list(self._subscribers):
+                fn(frame)

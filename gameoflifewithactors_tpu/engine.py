@@ -26,6 +26,7 @@ from jax.sharding import Mesh
 from .models.generations import GenRule, parse_any
 from .models.ltl import LtLRule
 from .models.rules import Rule
+from .obs import spans as obs_spans
 from .ops import bitpack
 from .ops.packed import multi_step_packed
 from .ops import pallas_stencil
@@ -702,17 +703,23 @@ class Engine:
             raise ValueError(f"cannot step a negative number of generations: {n}")
         if n == 0:
             return
-        if self._sparse is not None:
-            self._sparse.step(n)
-        else:
-            self._state = self._run(self._state, n)
+        # span = dispatch time only (async backends return before the device
+        # finishes); the sync cost shows under engine.sync, readback under
+        # engine.snapshot — the separation the telemetry report keys on
+        with obs_spans.span("engine.step", generations=n,
+                            backend=self.backend):
+            if self._sparse is not None:
+                self._sparse.step(n)
+            else:
+                self._state = self._run(self._state, n)
         self.generation += n
 
     def block_until_ready(self) -> None:
-        if self._sparse is not None:
-            self._sparse.padded.block_until_ready()  # no interior-slice copy
-        else:
-            self._state.block_until_ready()
+        with obs_spans.span("engine.sync"):
+            if self._sparse is not None:
+                self._sparse.padded.block_until_ready()  # no interior-slice copy
+            else:
+                self._state.block_until_ready()
 
     # -- observation ---------------------------------------------------------
 
@@ -732,15 +739,16 @@ class Engine:
         """The full grid as host uint8 (H, W); optionally block-max downsampled
         *on device* to fit within ``max_shape`` before transfer, so rendering
         a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
-        if self._gen_packed:
-            from .ops.packed_generations import unpack_generations
+        with obs_spans.span("engine.snapshot"):
+            if self._gen_packed:
+                from .ops.packed_generations import unpack_generations
 
-            dense = unpack_generations(self.state)
-        else:
-            dense = bitpack.unpack(self.state) if self._packed else self.state
-        if max_shape is not None:
-            dense = _downsample_max(dense, max_shape)
-        return np.asarray(dense)
+                dense = unpack_generations(self.state)
+            else:
+                dense = bitpack.unpack(self.state) if self._packed else self.state
+            if max_shape is not None:
+                dense = _downsample_max(dense, max_shape)
+            return np.asarray(dense)
 
     def halo_bytes_per_gen(self, source: str = "auto") -> int:
         """Interconnect (ICI/DCN) bytes one generation moves: the ppermute

@@ -1,0 +1,58 @@
+"""Run-telemetry subsystem: spans, compile events, metrics, watchdog, report.
+
+The reference's only observability was its console renderer [ABSENT];
+this package is the layer every perf/robustness claim reports through
+(ROADMAP north star: no further perf work can be trusted without it).
+Three pillars:
+
+- **Span tracer** (:mod:`.spans`): nested named host-side spans with a
+  context-manager API, thread-safe, exportable as chrome://tracing JSON
+  (loadable in ui.perfetto.dev *alongside* a ``jax.profiler`` device
+  trace — see README "Observability") and JSONL. The engine, coordinator
+  and scheduler are instrumented, so dispatch vs. sync vs. readback vs.
+  subscriber time is separable without a trace viewer.
+- **Compile-event tracker + metrics registry** (:mod:`.compile`,
+  :mod:`.registry`): every jit entry point in ``ops/_jit.py`` reports
+  which runner compiled, its shape/dtype signature and wall seconds —
+  so first-tick compile time stops masquerading as step time in
+  ``StepMetrics`` — plus labeled counters/gauges/histograms for
+  anything else worth counting.
+- **Stall watchdog + RunReport** (:mod:`.watchdog`, :mod:`.report`):
+  a monitor thread flags ticks exceeding a deadline and names the
+  last-completed span (aimed at the wedged-TPU-probe failure mode,
+  BENCH_r05.json), and :class:`RunReport` folds spans, compile events,
+  ``StepMetrics``, halo-byte figures and (when a trace exists)
+  ``perfetto_summary`` duty cycle into one JSON artifact — wired into
+  ``bench.py`` and the CLI (``--telemetry-out``, ``report`` subcommand).
+
+No module in this package imports jax at module scope (device/engine
+lookups are lazy, inside the calls that need them), mirroring how
+bench.py loads utils/provenance.py standalone: recorders and report
+plumbing must stay loadable and usable while a TPU tunnel is wedged —
+that is precisely when their output matters most.
+"""
+
+from .spans import Span, SpanTracer, TRACER, span  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from .compile import (  # noqa: F401
+    CompileEvent,
+    CompileEventLog,
+    COMPILE_LOG,
+    tracked_call,
+)
+from .watchdog import StallEvent, StallWatchdog, active_watchdog, arm, disarm  # noqa: F401
+from .report import RunReport, RunTelemetry, begin_run_telemetry  # noqa: F401
+
+__all__ = [
+    "Span", "SpanTracer", "TRACER", "span",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "CompileEvent", "CompileEventLog", "COMPILE_LOG", "tracked_call",
+    "StallEvent", "StallWatchdog", "active_watchdog", "arm", "disarm",
+    "RunReport", "RunTelemetry", "begin_run_telemetry",
+]

@@ -1,0 +1,142 @@
+"""Compile-event tracking for the jit entry points in ``ops/_jit.py``.
+
+A first tick through a fresh runner pays XLA compilation — seconds,
+against the microseconds a steady-state dispatch costs — and before this
+module that time hid inside ``StepMetrics.wall_seconds`` (and inside the
+bench autotune probe, and inside "why is the first tick 400x slower").
+``tracked_call`` wraps every execution of an ``optionally_donated``
+runner: when the call grew the jit cache (``_cache_size``, with a
+signature-keyed fallback for jax versions without it), a
+:class:`CompileEvent` records which runner, the shape/dtype signature
+that triggered the trace, and the call's wall seconds.
+
+The recorded ``wall_seconds`` is the *whole compiling call* — trace +
+XLA compile + the first dispatch. The dispatch share is the steady-state
+call time (microseconds), so the figure is compile time to within noise;
+the coordinator subtracts exactly this from the tick it happened in.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, Deque, List, Optional
+
+from .registry import REGISTRY
+
+MAX_EVENTS = 4096  # a runaway retrace loop must not grow memory unbounded
+
+
+def _describe(x) -> str:
+    """'u32[512,16]'-style for array-likes, short repr otherwise."""
+    dtype = getattr(x, "dtype", None)
+    shape = getattr(x, "shape", None)
+    if dtype is not None and shape is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    r = repr(x)
+    return r if len(r) <= 32 else r[:29] + "..."
+
+
+def signature_of(args, kwargs) -> str:
+    parts = [_describe(a) for a in args]
+    parts += [f"{k}={_describe(v)}" for k, v in sorted(kwargs.items())]
+    return ", ".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileEvent:
+    runner: str            # the wrapped function's name
+    signature: str         # shape/dtype signature that triggered the trace
+    wall_seconds: float    # the compiling call's wall time (compile-dominated)
+    cache_miss: bool       # True: this call compiled; False: cache-hit probe
+    donated: bool          # which of the two jit instances compiled
+    t0: float              # perf_counter at call start
+    t1: float              # perf_counter at completion
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class CompileEventLog:
+    """Thread-safe bounded log of compile events, queryable by window —
+    the coordinator asks "how much compile landed inside this tick"."""
+
+    def __init__(self, maxlen: int = MAX_EVENTS):
+        self._events: Deque[CompileEvent] = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, ev: CompileEvent) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[CompileEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def total_compile_seconds(self) -> float:
+        return sum(e.wall_seconds for e in self.events() if e.cache_miss)
+
+    def compile_seconds_between(self, t0: float, t1: float) -> float:
+        """Compile seconds of events that *completed* in the
+        ``perf_counter`` window [t0, t1] — a compiling call completes
+        inside the tick that paid for it, so completion time is the
+        right attribution point."""
+        return sum(e.wall_seconds for e in self.events()
+                   if e.cache_miss and t0 <= e.t1 <= t1)
+
+
+COMPILE_LOG = CompileEventLog()
+
+# fallback bookkeeping for jitted objects without _cache_size: signatures
+# this process has already seen per wrapped instance
+_SEEN_SIGS: dict = {}
+_SEEN_LOCK = threading.Lock()
+
+
+def _cache_size(target) -> Optional[int]:
+    try:
+        return target._cache_size()
+    except Exception:
+        return None
+
+
+def tracked_call(target: Callable, runner: str, args: tuple, kwargs: dict,
+                 *, donated: bool = False, log: CompileEventLog = None):
+    """Execute ``target(*args, **kwargs)``, recording a CompileEvent when
+    the call compiled. The non-compiling path costs two ``perf_counter``
+    and one ``_cache_size`` pair — noise against any dispatch."""
+    log = log if log is not None else COMPILE_LOG
+    before = _cache_size(target)
+    t0 = time.perf_counter()
+    out = target(*args, **kwargs)
+    t1 = time.perf_counter()
+    if before is not None:
+        missed = (_cache_size(target) or 0) > before
+    else:
+        # no _cache_size on this jax: first sight of (instance, signature)
+        # approximates a miss (weaker: it can't see re-traces after a
+        # cache eviction, but never false-positives on a steady state)
+        sig = signature_of(args, kwargs)
+        k = (id(target), sig)
+        with _SEEN_LOCK:
+            missed = k not in _SEEN_SIGS
+            _SEEN_SIGS[k] = True
+    if missed:
+        ev = CompileEvent(
+            runner=runner, signature=signature_of(args, kwargs),
+            wall_seconds=t1 - t0, cache_miss=True, donated=donated,
+            t0=t0, t1=t1)
+        log.record(ev)
+        REGISTRY.counter(
+            "jit_compiles", "jit cache misses (one XLA compile each)"
+        ).inc(runner=runner)
+        REGISTRY.histogram(
+            "jit_compile_seconds", "wall seconds of compiling calls"
+        ).observe(t1 - t0, runner=runner)
+    return out

@@ -1,0 +1,251 @@
+"""RunReport: one JSON artifact answering "where did this run's time go".
+
+Folds the other pillars together — host spans (per-phase time:
+dispatch / sync / readback / subscribers), compile events, ``StepMetrics``
+records, model-vs-measured halo bytes, stall events, the metrics
+registry, and (when a perfetto trace exists) the measured device duty
+cycle from ``utils.profiling.perfetto_summary``. Written by the CLI
+(``--telemetry-out``), ``bench.py`` and ``examples/telemetry.py``; read
+back by the ``report`` CLI subcommand and :meth:`RunReport.load`.
+
+:class:`RunTelemetry` is the session object: ``begin_run_telemetry()``
+resets the process-global tracer/compile log, arms the stall watchdog,
+and hands back the ``StepMetrics`` buffer sink to hang on a coordinator;
+``finish()`` assembles the report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import List, Optional
+
+from . import compile as compile_lib
+from . import spans as spans_lib
+from . import watchdog as watchdog_lib
+from .registry import REGISTRY
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class RunReport:
+    created_at: str                    # ISO-8601 UTC
+    config: dict                       # free-form run description
+    platform: dict                     # jax platform/devices (may be empty)
+    phase_seconds: dict                # span name -> {total_s, count, mean_s}
+    spans: List[dict]                  # individual span records
+    compile_events: List[dict]
+    compile_seconds_total: float
+    step_metrics: List[dict]           # StepMetrics.to_dict() records
+    halo_bytes: dict                   # {"model_per_gen", "measured_per_gen"}
+    stalls: List[dict]
+    metrics: dict                      # registry snapshot
+    perfetto: Optional[dict] = None    # device duty cycle, when a trace exists
+    schema_version: int = SCHEMA_VERSION
+
+    # -- serialization -------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 1) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+            f.write("\n")
+        return path
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunReport":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_json(cls, text: str) -> "RunReport":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def load(cls, path: str) -> "RunReport":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    # -- the human face (the `report` CLI subcommand) ------------------------
+
+    def summary_lines(self) -> List[str]:
+        lines = [f"RunReport {self.created_at}  "
+                 f"platform={self.platform.get('platform', '?')}"]
+        if self.config:
+            lines.append("config: " + json.dumps(self.config, sort_keys=True))
+        if self.phase_seconds:
+            lines.append("host phases (where the wall-clock went):")
+            width = max(map(len, self.phase_seconds))
+            for name, rec in sorted(self.phase_seconds.items(),
+                                    key=lambda kv: -kv[1]["total_s"]):
+                lines.append(
+                    f"  {name:{width}}  {rec['total_s']:10.4f}s"
+                    f"  x{rec['count']:<6} mean {rec['mean_s']:.4f}s")
+        misses = [e for e in self.compile_events if e.get("cache_miss")]
+        lines.append(
+            f"compiles: {len(misses)} "
+            f"({self.compile_seconds_total:.2f}s total)")
+        for e in misses:
+            lines.append(f"  {e['wall_seconds']:8.3f}s  {e['runner']}"
+                         f"({e['signature']})")
+        if self.step_metrics:
+            rates = [m["cell_updates_per_sec"] for m in self.step_metrics]
+            lines.append(
+                f"step metrics: {len(self.step_metrics)} records, "
+                f"best {max(rates):.3g} cell-updates/s")
+        hb = self.halo_bytes or {}
+        if hb.get("model_per_gen") is not None:
+            meas = hb.get("measured_per_gen")
+            lines.append(
+                f"halo bytes/gen: model {hb['model_per_gen']}"
+                + (f", measured {meas}" if meas is not None else ""))
+        if self.stalls:
+            lines.append(f"STALLS: {len(self.stalls)}")
+            for s in self.stalls:
+                lines.append(
+                    f"  {s['label']}: {s['elapsed_seconds']:.1f}s "
+                    f"(deadline {s['deadline_seconds']:.1f}s), last span "
+                    f"{s['last_completed_span'] or '<none>'}")
+        if self.perfetto:
+            busy, span = (self.perfetto.get("device_busy_us", 0.0),
+                          self.perfetto.get("device_span_us", 0.0))
+            if span:
+                lines.append(
+                    f"device duty cycle: {busy / span:.1%} "
+                    f"({self.perfetto.get('device_track')})")
+        return lines
+
+
+def _platform_info() -> dict:
+    """Best-effort device description; {} when jax is unimportable or the
+    backend refuses (a wedged tunnel must not take the report down)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {"platform": devs[0].platform,
+                "device_kind": devs[0].device_kind,
+                "device_count": len(devs)}
+    except Exception:
+        return {}
+
+
+def build_run_report(
+    *,
+    tracer: Optional[spans_lib.SpanTracer] = None,
+    compile_log: Optional[compile_lib.CompileEventLog] = None,
+    step_records: Optional[list] = None,
+    engine=None,
+    watchdog: Optional[watchdog_lib.StallWatchdog] = None,
+    trace_path: Optional[str] = None,
+    config: Optional[dict] = None,
+    halo_bytes: Optional[dict] = None,
+) -> RunReport:
+    """Assemble a RunReport from whichever pillars the run exercised.
+
+    ``step_records`` may be StepMetrics objects or plain dicts. Halo
+    bytes: the arithmetic model always (cheap, pinned == HLO in
+    tests/test_halo_bytes.py); the measured HLO figure only when the
+    engine already computed it (no surprise compile at report time).
+    ``halo_bytes`` overrides for engine-less callers (bench.py times raw
+    ops runners single-device, where the honest figure is 0).
+    """
+    tracer = tracer or spans_lib.TRACER
+    compile_log = compile_log or compile_lib.COMPILE_LOG
+
+    halo: dict = dict(halo_bytes or {})
+    if engine is not None:
+        halo["model_per_gen"] = engine.halo_bytes_per_gen(source="model")
+        measured = getattr(engine, "_halo_hlo", None)
+        halo["measured_per_gen"] = measured
+        config = dict(config or {})
+        config.setdefault("shape", list(engine.shape))
+        config.setdefault("rule", engine.rule.notation)
+        config.setdefault("backend", engine.backend)
+        config.setdefault("sharded", engine.mesh is not None)
+
+    perfetto = None
+    if trace_path:
+        from ..utils.profiling import perfetto_summary
+
+        try:
+            perfetto = perfetto_summary(trace_path)
+        except Exception as exc:  # a malformed trace must not eat the report
+            perfetto = {"error": f"{type(exc).__name__}: {exc}"}
+
+    records = []
+    for m in step_records or []:
+        records.append(m if isinstance(m, dict) else m.to_dict())
+
+    return RunReport(
+        created_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        config=config or {},
+        platform=_platform_info(),
+        phase_seconds=tracer.phase_seconds(),
+        spans=[s.to_dict() for s in tracer.spans()],
+        compile_events=[e.to_dict() for e in compile_log.events()],
+        compile_seconds_total=compile_log.total_compile_seconds(),
+        step_metrics=records,
+        halo_bytes=halo,
+        stalls=[e.to_dict() for e in (watchdog.events if watchdog else [])],
+        metrics=REGISTRY.snapshot(),
+        perfetto=perfetto,
+    )
+
+
+class RunTelemetry:
+    """One run's telemetry session over the process-global recorders."""
+
+    def __init__(self, *, stall_deadline: Optional[float] = None):
+        from ..utils.metrics import BufferSink
+
+        spans_lib.TRACER.clear()
+        compile_lib.COMPILE_LOG.clear()
+        self.step_buffer = BufferSink()
+        self.watchdog: Optional[watchdog_lib.StallWatchdog] = None
+        if stall_deadline:
+            self.watchdog = watchdog_lib.arm(
+                watchdog_lib.StallWatchdog(stall_deadline))
+
+    def attach(self, coordinator) -> None:
+        """Hang the StepMetrics buffer on a coordinator (creating its
+        MetricsLogger when it has none)."""
+        from ..utils.metrics import MetricsLogger
+
+        if coordinator.metrics is None:
+            coordinator.metrics = MetricsLogger(self.step_buffer)
+        else:
+            coordinator.metrics.add_sink(self.step_buffer)
+
+    def finish(self, *, engine=None, trace_path: Optional[str] = None,
+               config: Optional[dict] = None,
+               halo_bytes: Optional[dict] = None) -> RunReport:
+        """Disarm the watchdog and assemble the report. When an engine is
+        given, close the run observably first: a sync (so in-flight
+        dispatches land inside the spans being reported) and a tiny
+        downsampled snapshot (so the readback phase exists even for runs
+        that never rendered)."""
+        if engine is not None:
+            engine.block_until_ready()
+            engine.snapshot(max_shape=(8, 8))
+        if self.watchdog is not None and self.watchdog is \
+                watchdog_lib.active_watchdog():
+            watchdog_lib.disarm()
+        return build_run_report(
+            step_records=self.step_buffer.records, engine=engine,
+            watchdog=self.watchdog, trace_path=trace_path, config=config,
+            halo_bytes=halo_bytes)
+
+
+def begin_run_telemetry(*, stall_deadline: Optional[float] = None
+                        ) -> RunTelemetry:
+    """Start a fresh telemetry session (clears the global tracer and
+    compile log — earlier runs' spans must not leak into this report)."""
+    return RunTelemetry(stall_deadline=stall_deadline)

@@ -1,0 +1,192 @@
+"""Host-side span tracer: nested named regions, chrome-trace exportable.
+
+``jax.profiler`` answers "what did the *device* do"; this answers "what
+did the *host* do between dispatches" — the half of a stall that a
+device trace cannot see (a wedged tunnel shows an empty device timeline
+and a host stuck inside one span; the span name is the diagnosis). Spans
+nest via a per-thread stack, recording is thread-safe, and the export is
+chrome://tracing JSON, so a host span file drops into ui.perfetto.dev
+next to a ``jax.profiler`` perfetto dump for a combined timeline.
+
+The default :data:`TRACER` is always on: recording a span is two
+``perf_counter`` calls and a deque append (~1 µs), noise against a
+device dispatch, and the ring buffer bounds memory on long runs.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Deque, Iterator, List, Optional, TextIO
+
+DEFAULT_MAX_SPANS = 65536
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed region. ``t0``/``t1`` are ``time.perf_counter``
+    seconds; add the tracer's ``epoch_anchor`` for wall-clock time."""
+
+    name: str
+    t0: float
+    t1: float
+    thread_id: int
+    thread_name: str
+    depth: int                      # nesting level at record time (0 = root)
+    attrs: Optional[dict] = None
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "t0": self.t0, "t1": self.t1,
+             "seconds": self.seconds, "thread": self.thread_name,
+             "depth": self.depth}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class SpanTracer:
+    """Thread-safe recorder of nested spans with a bounded ring buffer."""
+
+    def __init__(self, maxlen: int = DEFAULT_MAX_SPANS, enabled: bool = True):
+        self._spans: Deque[Span] = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._last: Optional[Span] = None
+        self.enabled = enabled
+        # perf_counter -> wall-clock anchor, so exported timestamps can be
+        # correlated with a jax.profiler trace captured in the same process
+        self.epoch_anchor = time.time() - time.perf_counter()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        """``with tracer.span("engine.step", generations=8): ...``"""
+        if not self.enabled:
+            yield
+            return
+        stack = self._live_stack()
+        depth = len(stack)
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            stack.pop()
+            th = threading.current_thread()
+            s = Span(name=name, t0=t0, t1=t1, thread_id=th.ident or 0,
+                     thread_name=th.name, depth=depth, attrs=attrs or None)
+            with self._lock:
+                self._spans.append(s)
+                self._last = s
+
+    # -- inspection ----------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def last_completed(self) -> Optional[Span]:
+        """The most recently *finished* span — what the stall watchdog
+        names when a tick wedges (the span after it never completed)."""
+        with self._lock:
+            return self._last
+
+    def current_stack(self) -> List[str]:
+        """This thread's open spans, outermost first."""
+        return list(getattr(self._local, "stack", ()))
+
+    def _live_stack(self) -> List[str]:
+        """The calling thread's live stack *object* (created if absent).
+        The stall watchdog snapshots this from its monitor thread — a
+        thread-local read over there would see the monitor's own (empty)
+        stack, so the watched thread's list must be captured by identity
+        at watch() time. Copying it cross-thread is safe: span() only
+        appends/pops under the GIL."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._last = None
+
+    def phase_seconds(self) -> dict:
+        """Per-name totals/counts — PhaseTimer-shaped, derived from spans.
+
+        Nested spans each count their own wall time (``engine.step``
+        inside ``coordinator.tick`` appears under both names), which is
+        exactly what "where did the host time go, by layer" wants."""
+        out: dict = {}
+        for s in self.spans():
+            rec = out.setdefault(s.name, {"total_s": 0.0, "count": 0})
+            rec["total_s"] += s.seconds
+            rec["count"] += 1
+        for rec in out.values():
+            rec["mean_s"] = rec["total_s"] / rec["count"]
+        return out
+
+    # -- export --------------------------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        """chrome://tracing / Perfetto JSON object format. Timestamps are
+        wall-clock microseconds (epoch-anchored), so this file and a
+        ``jax.profiler`` dump from the same process line up when both are
+        opened in ui.perfetto.dev."""
+        pid = os.getpid()
+        events = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": "gameoflifewithactors_tpu host spans"},
+        }]
+        seen_threads = set()
+        for s in self.spans():
+            if s.thread_id not in seen_threads:
+                seen_threads.add(s.thread_id)
+                events.append({
+                    "ph": "M", "pid": pid, "tid": s.thread_id,
+                    "name": "thread_name",
+                    "args": {"name": s.thread_name},
+                })
+            ev = {
+                "ph": "X", "pid": pid, "tid": s.thread_id, "name": s.name,
+                "ts": (s.t0 + self.epoch_anchor) * 1e6,
+                "dur": s.seconds * 1e6,
+            }
+            if s.attrs:
+                ev["args"] = s.attrs
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.write("\n")
+        return path
+
+    def write_jsonl(self, stream_or_path: "TextIO | str") -> None:
+        """One span per line (`tail -f`-able; the log-shipping form)."""
+        if isinstance(stream_or_path, str):
+            with open(stream_or_path, "w") as f:
+                self.write_jsonl(f)
+            return
+        for s in self.spans():
+            stream_or_path.write(json.dumps(s.to_dict()) + "\n")
+
+
+TRACER = SpanTracer()
+
+
+def span(name: str, **attrs):
+    """Record on the process-default tracer (what the engine/coordinator/
+    scheduler instrumentation uses)."""
+    return TRACER.span(name, **attrs)

@@ -1,0 +1,177 @@
+"""Stall watchdog: a monitor thread that flags ticks exceeding a deadline.
+
+Aimed squarely at the wedged-TPU-probe failure mode (BENCH_r05.json): a
+tunnel wedge shows up as a tick that never returns, and before this the
+only diagnostic was a subprocess timeout with zero context. The watchdog
+watches each tick from a separate thread; when one overruns its
+deadline it emits a :class:`StallEvent` naming the *last-completed span*
+— so "wedged inside the first compile" vs. "wedged in snapshot readback"
+vs. "wedged in a subscriber callback" is readable straight off the
+report, without a debugger attached to the hung process.
+
+One event per stalled tick (not one per poll), and the event fires
+*while the tick is still stuck* — that is the point: the diagnosis must
+escape (stderr, a sink, the RunReport of a parallel thread) even if the
+tick never finishes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable, Iterator, List, Optional
+
+from . import spans as spans_lib
+from .registry import REGISTRY
+
+
+@dataclasses.dataclass(frozen=True)
+class StallEvent:
+    label: str                          # what was being watched ("tick@gen8")
+    elapsed_seconds: float              # overrun at detection time
+    deadline_seconds: float
+    last_completed_span: Optional[str]  # where progress was last observed
+    open_spans: tuple                   # the stalled thread's span stack
+    t: float                            # perf_counter at detection
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["open_spans"] = list(self.open_spans)
+        return d
+
+
+def _default_on_stall(ev: StallEvent) -> None:
+    sys.stderr.write(
+        f"STALL: {ev.label} exceeded its {ev.deadline_seconds:.1f}s deadline "
+        f"({ev.elapsed_seconds:.1f}s elapsed); last completed span: "
+        f"{ev.last_completed_span or '<none>'}"
+        + (f"; open: {' > '.join(ev.open_spans)}" if ev.open_spans else "")
+        + "\n")
+
+
+class StallWatchdog:
+    """``with wd.watch("tick@gen8"): coordinator.tick(...)``.
+
+    The monitor thread polls at ``deadline/4`` (min 10 ms, max 500 ms);
+    detection latency is at most one poll past the deadline. ``on_stall``
+    defaults to a stderr line; the RunReport reads ``wd.events`` either
+    way. Use as a context manager (``with StallWatchdog(1.0) as wd:``)
+    or call :meth:`start`/:meth:`stop` explicitly."""
+
+    def __init__(self, deadline_seconds: float, *,
+                 tracer: Optional[spans_lib.SpanTracer] = None,
+                 on_stall: Optional[Callable[[StallEvent], None]] = None):
+        if deadline_seconds <= 0:
+            raise ValueError(
+                f"deadline must be positive, got {deadline_seconds}")
+        self.deadline = float(deadline_seconds)
+        self._tracer = tracer or spans_lib.TRACER
+        self._on_stall = on_stall or _default_on_stall
+        self.events: List[StallEvent] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # the watched tick: (label, t0, watched thread's live span stack,
+        # flagged)
+        self._active: Optional[list] = None
+
+    # -- the watched section -------------------------------------------------
+
+    @contextlib.contextmanager
+    def watch(self, label: str) -> Iterator[None]:
+        # capture the watched thread's live stack object NOW: the monitor
+        # thread must read THIS thread's open spans, and a thread-local
+        # getter called over there would see the monitor's own stack
+        stack = self._tracer._live_stack()
+        with self._lock:
+            self._active = [label, time.perf_counter(), stack, False]
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._active = None
+
+    # -- the monitor thread --------------------------------------------------
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._monitor, name="stall-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _monitor(self) -> None:
+        poll = min(max(self.deadline / 4.0, 0.01), 0.5)
+        while not self._stop.wait(poll):
+            self._check(time.perf_counter())
+
+    def _check(self, now: float) -> Optional[StallEvent]:
+        """One poll; factored out so tests can drive detection without
+        racing a real thread."""
+        with self._lock:
+            active = self._active
+            if active is None or active[3]:
+                return None
+            label, t0, stack, _ = active
+            elapsed = now - t0
+            if elapsed <= self.deadline:
+                return None
+            active[3] = True  # one event per stalled tick
+        last = self._tracer.last_completed()
+        ev = StallEvent(
+            label=label, elapsed_seconds=elapsed,
+            deadline_seconds=self.deadline,
+            last_completed_span=last.name if last else None,
+            open_spans=tuple(stack), t=now)
+        self.events.append(ev)
+        REGISTRY.counter("stalls", "ticks that overran the watchdog deadline"
+                         ).inc(label=label)
+        try:
+            self._on_stall(ev)
+        except Exception:
+            pass  # a broken sink must not kill the monitor thread
+        return ev
+
+
+# -- process-default arming (how the coordinator finds the watchdog) ---------
+#
+# GridCoordinator.tick wraps itself in the armed watchdog's watch() when
+# one is armed, so telemetry setup needs no coordinator plumbing and a
+# library user can arm/disarm around any code at all.
+
+_ACTIVE: Optional[StallWatchdog] = None
+
+
+def arm(wd: StallWatchdog) -> StallWatchdog:
+    """Make ``wd`` the process-default watchdog (started) and return it."""
+    global _ACTIVE
+    _ACTIVE = wd.start()
+    return wd
+
+
+def disarm() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.stop()
+    _ACTIVE = None
+
+
+def active_watchdog() -> Optional[StallWatchdog]:
+    return _ACTIVE

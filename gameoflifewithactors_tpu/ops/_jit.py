@@ -13,6 +13,13 @@ and wants in-place double-buffering (at 65536² packed that is the
 difference between 512 MB and 1 GB of HBM) — but as an explicit
 ``donate=True`` opt-in. Two jitted instances are built per function
 (jax.jit donation is a trace-time property); the wrapper picks one.
+
+Being the choke point every ``step_*``/``multi_step_*`` call flows
+through also makes this the natural place to *see* compiles: each call
+routes through :func:`obs.compile.tracked_call`, which records a
+CompileEvent (runner name, shape/dtype signature, wall seconds) whenever
+the call grew the jit cache — the data that lets StepMetrics stop
+reporting first-tick compile time as step time.
 """
 
 from __future__ import annotations
@@ -21,6 +28,8 @@ import functools
 from typing import Callable, Sequence, Tuple
 
 import jax
+
+from ..obs import compile as _obs_compile
 
 
 def optionally_donated(
@@ -32,10 +41,13 @@ def optionally_donated(
     def deco(fun: Callable) -> Callable:
         plain = jax.jit(fun, static_argnames=static)
         donating = jax.jit(fun, static_argnames=static, donate_argnames=(donate_arg,))
+        name = fun.__name__
 
         @functools.wraps(fun)
         def wrapper(*args, donate: bool = False, **kwargs):
-            return (donating if donate else plain)(*args, **kwargs)
+            return _obs_compile.tracked_call(
+                donating if donate else plain, name, args, kwargs,
+                donated=donate)
 
         # the jit objects themselves, for .lower()/.trace() introspection
         wrapper.jitted = plain

@@ -42,6 +42,7 @@ Tests run the kernel in interpret mode on CPU against step_packed.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Optional
 
@@ -259,12 +260,22 @@ def _make_gen_kernel(rule, topology: Topology, b: int, H: int, Wp: int,
 
 
 def _validate_slab(He: int, bh: int, g: int, interpret: bool,
-                   Wp: int = 0, planes: int = 1) -> None:
+                   Wp: int = 0, planes: int = 1,
+                   vmem_bytes=None, budget: int = 0) -> None:
     """Shared kernel shape guards (binary and Generations, full-grid and
     slab forms). ``Wp`` (words per row, per plane) adds the lane-alignment
     and VMEM-budget checks so an explicit block_rows / band request fails
     with a clean ValueError here instead of an opaque Mosaic compile error
-    on chip (advisor round-2 finding)."""
+    on chip (advisor round-2 finding). ``vmem_bytes``/``budget`` let a
+    caller whose kernel has its own footprint model (the bit-sliced LtL
+    form budgets against the raised scoped-vmem cap) validate against
+    *that*, instead of the binary double-buffer model vs the fixed 14 MiB
+    — which held for LtL only by arithmetic coincidence (advisor r5 #1:
+    binary_model <= 2/7 * ltl_model, and 2/7 * 48 MiB happens to land
+    under 14 MiB; tests/test_pallas.py pins that invariant so the
+    coincidence can't silently break for callers still relying on it)."""
+    vmem_bytes = vmem_bytes or _vmem_bytes
+    budget = budget or _VMEM_BUDGET
     if He % bh:
         raise ValueError(
             f"height {He} not divisible by block rows {bh}")
@@ -284,12 +295,12 @@ def _validate_slab(He: int, bh: int, g: int, interpret: bool,
         raise ValueError(
             f"native TPU kernel needs the packed width ({Wp} words = "
             f"{Wp * 32} cells) to be a multiple of 128 words (lane tiling)")
-    if not interpret and Wp and _vmem_bytes(bh, g, Wp * planes) > _VMEM_BUDGET:
+    if not interpret and Wp and vmem_bytes(bh, g, Wp * planes) > budget:
         raise ValueError(
-            f"kernel VMEM footprint {_vmem_bytes(bh, g, Wp * planes)} bytes "
+            f"kernel VMEM footprint {vmem_bytes(bh, g, Wp * planes)} bytes "
             f"(block_rows={bh}, gens={g}, width {Wp * 32} cells"
             + (f", {planes} planes" if planes > 1 else "")
-            + f") exceeds the {_VMEM_BUDGET >> 20} MiB budget; "
+            + f") exceeds the {budget >> 20} MiB budget; "
               "use smaller block_rows or a narrower grid")
 
 
@@ -417,15 +428,10 @@ def make_ltl_pallas_slab_step(
     if hr > bh:
         raise ValueError(
             f"LtL slab kernel needs radius*gens ({hr}) <= block_rows ({bh})")
-    _validate_slab(He, bh, hr, interpret, Wp=Wp)
-    if not interpret and vmem_model(bh, hr, Wp) > budget:
-        # the generic check models the binary kernel; the bit-sliced box
-        # sum's count planes need the larger LtL budget
-        raise ValueError(
-            f"LtL kernel VMEM footprint {vmem_model(bh, hr, Wp)} bytes "
-            f"(block_rows={bh}, radius*gens={hr}, width {Wp * 32} cells) "
-            f"exceeds the {budget >> 20} MiB budget; use smaller "
-            "block_rows or a shallower exchange")
+    # the generic check models the binary kernel; the bit-sliced box
+    # sum's count planes budget against the raised LtL scoped-vmem cap
+    _validate_slab(He, bh, hr, interpret, Wp=Wp,
+                   vmem_bytes=vmem_model, budget=budget)
     return _ltl_pallas_call(rule, topology, (He, Wp), bh, g, interpret,
                             slab_mode=True, dead_band=dead_band)
 
@@ -470,9 +476,23 @@ def _ltl_vmem_limit() -> int:
     on pre-v4 / unrecognized TPU cores where 64 MiB exceeds physical
     VMEM. The single decision point: :func:`_ltl_vmem_budget` keys off
     this same value, so block picking can never admit a shape the
-    compile-time cap then rejects (code-review r5)."""
+    compile-time cap then rejects (code-review r5).
+
+    ``GOLTPU_TPU_GENERATION`` (e.g. ``3``, ``v3``, ``v5e``) overrides
+    everything, including the local device kind: it names the *target*
+    core generation, so AOT cross-lowering from any host for a pre-v4
+    core can opt into the conservative 14/16 MiB budgets that the
+    host-platform fallback would otherwise lift (advisor r5 #3)."""
     import re
 
+    target = os.environ.get("GOLTPU_TPU_GENERATION", "").strip()
+    if target:
+        m = re.search(r"(\d+)", target)
+        if not m:
+            raise ValueError(
+                f"GOLTPU_TPU_GENERATION={target!r} names no TPU "
+                "generation; expected e.g. '3', 'v3', 'v5e'")
+        return _LTL_VMEM_LIMIT if int(m.group(1)) >= 4 else 0
     d = jax.devices()[0]
     if d.platform != "tpu":
         return _LTL_VMEM_LIMIT

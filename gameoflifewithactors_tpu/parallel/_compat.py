@@ -1,0 +1,30 @@
+"""jax API compatibility for the sharded runners.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map``, renaming ``check_rep`` to ``check_vma`` on the way.
+The runners are written against the graduated API; on older jax this
+adapter serves the experimental implementation under the new spelling,
+so every call site stays version-agnostic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    @functools.wraps(_experimental)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        else:
+            # the experimental checker predates replication rules for
+            # control flow (a fori_loop body raises NotImplementedError:
+            # "No replication rule for while"); the graduated API types
+            # these fine, so match its permissiveness rather than make
+            # every call site version-gate a static check
+            kwargs.setdefault("check_rep", False)
+        return _experimental(*args, **kwargs)

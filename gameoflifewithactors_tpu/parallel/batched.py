@@ -16,7 +16,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.rules import Rule

@@ -21,15 +21,20 @@ from typing import Callable, List, Optional, TextIO
 class StepMetrics:
     generation: int                    # generation counter after the step
     generations_stepped: int           # generations covered by this record
-    wall_seconds: float
+    wall_seconds: float                # stepping time: excludes compile_seconds
     cell_updates_per_sec: float
     population: Optional[int] = None
     halo_bytes: Optional[int] = None   # est. interconnect bytes this record
     active_tiles: Optional[int] = None  # sparse backends: tiles computed
+    # jit compile wall seconds this record's tick paid (obs/compile.py via
+    # ops/_jit.py); split out so a first tick's XLA compile never
+    # masquerades as step time — total tick wall = wall_seconds + this
+    compile_seconds: Optional[float] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
-        for k in ("population", "halo_bytes", "active_tiles"):
+        for k in ("population", "halo_bytes", "active_tiles",
+                  "compile_seconds"):
             if d[k] is None:
                 d.pop(k)
         return d

@@ -14,3 +14,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import axon_guard  # noqa: E402  (repo-root helper; must not import jax)
 
 axon_guard.force_cpu(8)
+
+
+def pytest_configure(config):
+    # the ROADMAP tier-1 command deselects these (-m 'not slow'); register
+    # the mark so its use never degrades into an unknown-mark warning
+    config.addinivalue_line(
+        "markers", "slow: excluded from the CPU tier-1 verify run "
+        "(pathological XLA CPU compile time or TPU-scale shapes)")

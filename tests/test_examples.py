@@ -71,3 +71,21 @@ def test_fault_recovery_replays_bit_exact(capsys):
     out = capsys.readouterr().out
     assert "dropped device shard" in out
     assert "final state bit-identical to the unfaulted run" in out
+
+
+def test_telemetry_example_all_pillars(tmp_path, capsys):
+    import json
+
+    from examples.telemetry import main
+
+    out = str(tmp_path / "rep.json")
+    main(["--side", "64", "--gens", "8", "--ticks", "4", "--out", out,
+          "--stall-demo"])
+    text = capsys.readouterr().out
+    assert "host phases" in text
+    assert "last completed span:" in text  # the watchdog diagnostic fired
+    rep = json.load(open(out))
+    assert rep["phase_seconds"]["coordinator.tick"]["count"] == 4
+    assert len(rep["step_metrics"]) == 4
+    # the chrome-trace sibling for the perfetto overlay
+    assert (tmp_path / "rep.trace.json").exists()

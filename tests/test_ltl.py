@@ -240,7 +240,13 @@ class TestVonNeumann:
     @pytest.mark.parametrize("notation", [
         "R2,C0,M1,S2..6,B3..5,NN",
         "R1,C0,M0,S2..3,B2..2,NN",
-        "R4,C0,M1,S10..22,B12..17,NN",
+        # slow: this container's XLA CPU takes >10 min inside ONE
+        # backend_compile of the R4 diamond packed kernel (verified by a
+        # faulthandler stack dump — compile, not deadlock), which blows
+        # the tier-1 budget; R1/R2 keep the packed-diamond path covered
+        # there, and full/TPU runs still exercise R4
+        pytest.param("R4,C0,M1,S10..22,B12..17,NN",
+                     marks=pytest.mark.slow),
     ])
     def test_packed_diamond_matches_dense(self, notation, topology):
         """The packed path serves diamond rules now (per-row-separable

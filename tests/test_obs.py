@@ -1,0 +1,363 @@
+"""The run-telemetry subsystem (obs/): spans, compile events, watchdog,
+RunReport — the layer every perf/robustness claim reports through.
+
+Covers the ISSUE-1 acceptance points: span nesting/threading and the
+chrome-trace/JSONL exports; compile-event capture at the ops/_jit choke
+point; the stall watchdog firing on a wedged tick and naming the
+last-completed span; RunReport JSON round-trip; and the regression that
+a tick's ``StepMetrics.wall_seconds`` excludes the compile time the
+same tick paid (the first-tick 400x mirage).
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from gameoflifewithactors_tpu.obs import compile as obs_compile
+from gameoflifewithactors_tpu.obs import report as report_lib
+from gameoflifewithactors_tpu.obs import spans as spans_lib
+from gameoflifewithactors_tpu.obs import watchdog as watchdog_lib
+from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+from gameoflifewithactors_tpu.obs.report import RunReport, begin_run_telemetry
+from gameoflifewithactors_tpu.obs.spans import SpanTracer
+from gameoflifewithactors_tpu.obs.watchdog import StallWatchdog
+
+
+# -- pillar 1: the span tracer ------------------------------------------------
+
+
+def test_span_nesting_depth_and_phase_totals():
+    tr = SpanTracer()
+    with tr.span("outer", layer="coordinator"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner"):
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["inner", "inner", "outer"]  # completion order
+    assert [s.depth for s in spans] == [1, 1, 0]
+    assert spans[-1].attrs == {"layer": "coordinator"}
+    assert all(s.t1 >= s.t0 for s in spans)
+    phases = tr.phase_seconds()
+    assert phases["inner"]["count"] == 2
+    # nested spans each count their own wall time: outer covers both inners
+    assert phases["outer"]["total_s"] >= phases["inner"]["total_s"]
+    assert tr.last_completed().name == "outer"
+
+
+def test_span_thread_safety_and_per_thread_stacks():
+    tr = SpanTracer()
+    n, per = 8, 50
+    barrier = threading.Barrier(n)
+
+    def work(i):
+        barrier.wait()
+        for _ in range(per):
+            with tr.span(f"t{i}", worker=i):
+                # nesting is per-thread: another thread's open span must
+                # not appear in this thread's stack
+                assert tr.current_stack() == [f"t{i}"]
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tr.spans()
+    assert len(spans) == n * per
+    assert all(s.depth == 0 for s in spans)
+
+
+def test_span_ring_buffer_bounds_memory():
+    tr = SpanTracer(maxlen=16)
+    for i in range(100):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.spans()
+    assert len(spans) == 16
+    assert spans[-1].name == "s99"
+
+
+def test_chrome_trace_and_jsonl_exports(tmp_path):
+    tr = SpanTracer()
+    with tr.span("a", k=1):
+        with tr.span("b"):
+            pass
+    path = tr.write_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"a", "b"}
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in xs)
+    # thread metadata present, so perfetto labels the host track
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in events)
+
+    buf = io.StringIO()
+    tr.write_jsonl(buf)
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert [l["name"] for l in lines] == ["b", "a"]
+    assert all("seconds" in l for l in lines)
+
+
+# -- pillar 2: compile events + registry --------------------------------------
+
+
+def test_tracked_call_records_compile_once():
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.ops._jit import optionally_donated
+
+    @optionally_donated("p", static=())
+    def _obs_probe(p):
+        return p + 1
+
+    log = obs_compile.CompileEventLog()
+    x = jnp.zeros((4, 4), jnp.uint32)
+    for _ in range(3):
+        obs_compile.tracked_call(_obs_probe.jitted, "_obs_probe", (x,), {},
+                                 log=log)
+    events = log.events()
+    assert len(events) == 1  # first call compiled; the rest were cache hits
+    ev = events[0]
+    assert ev.runner == "_obs_probe" and ev.cache_miss
+    assert "uint32[4,4]" in ev.signature
+    assert ev.wall_seconds > 0
+    # a new shape is a new trace: one more event, attributable by window
+    t_before = time.perf_counter()
+    obs_compile.tracked_call(_obs_probe.jitted, "_obs_probe",
+                             (jnp.zeros((8, 8), jnp.uint32),), {}, log=log)
+    t_after = time.perf_counter()
+    assert len(log.events()) == 2
+    assert log.compile_seconds_between(t_before, t_after) == pytest.approx(
+        log.events()[-1].wall_seconds)
+    assert log.total_compile_seconds() == pytest.approx(
+        sum(e.wall_seconds for e in log.events()))
+
+
+def test_engine_step_emits_compile_event():
+    """The jit entry points in ops/_jit.py are the choke point: stepping a
+    fresh (shape, rule) through the engine must leave a CompileEvent in
+    the global log, naming the runner."""
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+
+    obs_compile.COMPILE_LOG.clear()
+    eng = GridCoordinator((56, 64), "B3/S23", random_fill=0.4,
+                          backend="packed").engine
+    eng.step(2)
+    eng.block_until_ready()
+    misses = [e for e in obs_compile.COMPILE_LOG.events() if e.cache_miss]
+    assert misses, "first step of a fresh shape must record a compile"
+    assert any("multi_step" in e.runner for e in misses)
+
+
+def test_registry_instruments():
+    reg = MetricsRegistry()
+    reg.counter("evs").inc(runner="a")
+    reg.counter("evs").inc(2.5, runner="a")
+    reg.counter("evs").inc(runner="b")
+    assert reg.counter("evs").value(runner="a") == 3.5
+    with pytest.raises(ValueError):
+        reg.counter("evs").inc(-1)
+    reg.gauge("depth").set(7, q="x")
+    assert reg.gauge("depth").value(q="x") == 7
+    h = reg.histogram("secs")
+    for v in (0.0005, 0.05, 5.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["secs"]["series"][0]["n"] == 3
+    assert snap["secs"]["series"][0]["sum"] == pytest.approx(5.0505)
+    with pytest.raises(ValueError):
+        reg.gauge("evs")  # name already registered as a counter
+
+
+# -- pillar 3: the stall watchdog ---------------------------------------------
+
+
+def test_watchdog_fires_on_stalled_tick_and_names_last_span():
+    """The wedged-probe diagnostic: a tick that sleeps past the deadline
+    is flagged *while still stuck*, with the last-completed span named."""
+    tr = SpanTracer()
+    stalls = []
+    wd = StallWatchdog(0.08, tracer=tr, on_stall=stalls.append)
+    with wd:
+        with tr.span("engine.step"):
+            pass
+        with wd.watch("tick@gen0+1"):
+            with tr.span("engine.sync"):
+                deadline = time.perf_counter() + 2.0
+                while not stalls and time.perf_counter() < deadline:
+                    time.sleep(0.01)  # the wedge: sync never returns
+    assert len(stalls) == 1, "exactly one event per stalled tick"
+    ev = stalls[0]
+    assert ev.label == "tick@gen0+1"
+    assert ev.last_completed_span == "engine.step"
+    assert ev.elapsed_seconds > ev.deadline_seconds == pytest.approx(0.08)
+    assert ev.open_spans == ("engine.sync",)
+    assert wd.events == stalls
+
+
+def test_watchdog_check_is_deterministic():
+    """_check drives detection without racing the monitor thread."""
+    tr = SpanTracer()
+    wd = StallWatchdog(1.0, tracer=tr, on_stall=lambda ev: None)
+    with wd.watch("tick"):
+        t0 = wd._active[1]
+        assert wd._check(t0 + 0.5) is None          # within deadline
+        ev = wd._check(t0 + 1.5)                     # past deadline
+        assert ev is not None and ev.label == "tick"
+        assert wd._check(t0 + 2.0) is None           # one event per tick
+    assert wd._check(time.perf_counter()) is None    # nothing watched
+
+
+def test_watchdog_quiet_on_healthy_ticks():
+    stalls = []
+    with StallWatchdog(5.0, on_stall=stalls.append) as wd:
+        for _ in range(3):
+            with wd.watch("tick"):
+                pass
+    assert not stalls and not wd.events
+
+
+def test_coordinator_tick_runs_under_armed_watchdog():
+    """GridCoordinator.tick needs no plumbing: arming the process
+    watchdog is enough for a wedged subscriber to be flagged, with the
+    stall label naming the generation."""
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+
+    coord = GridCoordinator((24, 32), "B3/S23", random_fill=0.3)
+    stalls = []
+    wd = watchdog_lib.arm(StallWatchdog(0.05, on_stall=stalls.append))
+    try:
+        unsub = coord.subscribe(lambda frame: time.sleep(0.4))
+        coord.tick(1)
+        unsub()
+    finally:
+        watchdog_lib.disarm()
+    assert watchdog_lib.active_watchdog() is None
+    assert len(stalls) == 1
+    assert stalls[0].label.startswith("tick@gen")
+    assert stalls[0].last_completed_span is not None
+    assert wd.events == stalls
+
+
+# -- RunReport ----------------------------------------------------------------
+
+
+def test_run_report_json_round_trip(tmp_path):
+    tr = SpanTracer()
+    log = obs_compile.CompileEventLog()
+    with tr.span("engine.step"):
+        pass
+    log.record(obs_compile.CompileEvent(
+        runner="r", signature="uint32[8,8]", wall_seconds=1.25,
+        cache_miss=True, donated=False, t0=0.0, t1=1.25))
+    rep = report_lib.build_run_report(
+        tracer=tr, compile_log=log,
+        step_records=[{"generation": 8, "generations_stepped": 8,
+                       "wall_seconds": 0.1, "cell_updates_per_sec": 1e6}],
+        config={"side": 8}, halo_bytes={"model_per_gen": 4096,
+                                        "measured_per_gen": None})
+    path = rep.save(str(tmp_path / "report.json"))
+    back = RunReport.load(path)
+    assert back.to_dict() == rep.to_dict()
+    assert back.schema_version == report_lib.SCHEMA_VERSION
+    assert back.compile_seconds_total == pytest.approx(1.25)
+    assert back.phase_seconds["engine.step"]["count"] == 1
+    assert back.halo_bytes["model_per_gen"] == 4096
+    # unknown keys from a future schema are ignored, not fatal
+    d = rep.to_dict()
+    d["from_the_future"] = True
+    assert RunReport.from_dict(d).config == {"side": 8}
+    # the human summary renders every section without raising
+    text = "\n".join(back.summary_lines())
+    assert "engine.step" in text and "compiles: 1" in text
+
+
+def test_run_telemetry_session_end_to_end(tmp_path):
+    """begin_run_telemetry -> coordinator run -> finish: the report holds
+    spans (dispatch/sync/readback separable), >= 1 compile event with
+    wall seconds, StepMetrics, and halo-bytes figures — the ISSUE-1
+    acceptance artifact, in-process."""
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+    from gameoflifewithactors_tpu.scheduler import TickScheduler
+
+    telem = begin_run_telemetry()
+    # a fresh session must not inherit earlier runs' spans/compiles
+    assert not spans_lib.TRACER.spans()
+    assert not obs_compile.COMPILE_LOG.events()
+    coord = GridCoordinator((40, 32), "B36/S23", random_fill=0.4,
+                            track_population=True)
+    telem.attach(coord)
+    TickScheduler(coord, generations_per_tick=2).run(max_generations=6)
+    rep = telem.finish(engine=coord.engine, config={"steps": 6})
+    phases = rep.phase_seconds
+    for name in ("scheduler.run", "coordinator.tick", "engine.step",
+                 "engine.sync", "engine.snapshot"):
+        assert name in phases, name
+    assert phases["coordinator.tick"]["count"] == 3
+    misses = [e for e in rep.compile_events if e["cache_miss"]]
+    assert misses and all(e["wall_seconds"] > 0 for e in misses)
+    assert len(rep.step_metrics) == 3
+    assert rep.halo_bytes["model_per_gen"] == coord.engine.halo_bytes_per_gen(
+        source="model")
+    assert rep.config["steps"] == 6 and rep.config["rule"] == "B36/S23"
+    assert rep.platform.get("platform") == "cpu"
+    # saved artifact is the acceptance-criteria JSON
+    back = RunReport.load(rep.save(str(tmp_path / "run.json")))
+    assert back.to_dict() == rep.to_dict()
+
+
+def test_report_cli_subcommand(tmp_path, capsys):
+    from gameoflifewithactors_tpu import cli
+
+    rep = report_lib.build_run_report(
+        tracer=SpanTracer(), compile_log=obs_compile.CompileEventLog(),
+        config={"demo": True})
+    path = str(tmp_path / "r.json")
+    rep.save(path)
+    assert cli.main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "RunReport" in out and "compiles: 0" in out
+    assert cli.main(["report", path, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["config"] == {"demo": True}
+
+
+# -- the StepMetrics compile-exclusion regression -----------------------------
+
+
+def test_step_metrics_exclude_compile_time():
+    """ISSUE-1 regression: the compile a tick pays is reported in
+    ``compile_seconds``, never inside ``wall_seconds`` — so post-warmup
+    rates and first-tick rates describe the same quantity (stepping)."""
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+    from gameoflifewithactors_tpu.utils.metrics import BufferSink, MetricsLogger
+
+    buf = BufferSink()
+    # an unusual (shape, rule) so this process has certainly not compiled
+    # the runner yet: the first tick must pay and report the compile
+    coord = GridCoordinator((72, 96), "B2/S345", random_fill=0.3,
+                            metrics=MetricsLogger(buf))
+    t0 = time.perf_counter()
+    coord.tick(2)
+    elapsed = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    coord.tick(2)
+    warm_elapsed = time.perf_counter() - t1
+
+    first, warm = buf.records
+    assert first.compile_seconds and first.compile_seconds > 0
+    # wall = (step+sync time) - compile; elapsed >= step+sync, so the
+    # bound below is exact arithmetic, not a timing guess
+    assert first.wall_seconds <= elapsed - first.compile_seconds + 1e-6
+    assert first.wall_seconds > 0
+    # post-warmup: no compile to report, and the rate is computed from
+    # a wall time in line with the actual tick duration
+    assert warm.compile_seconds is None
+    assert warm.wall_seconds <= warm_elapsed + 1e-6
+    assert warm.cell_updates_per_sec == pytest.approx(
+        72 * 96 * 2 / warm.wall_seconds)
+    # serialized form drops the None, keeps the figure when present
+    assert "compile_seconds" in first.to_dict()
+    assert "compile_seconds" not in warm.to_dict()

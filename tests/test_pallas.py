@@ -405,3 +405,76 @@ def test_ltl_vmem_gate_calibration_and_guards(monkeypatch):
         ps.make_ltl_pallas_step(bosco, Topology.TORUS, (8192, 4096),
                                 block_rows=8192, gens_per_call=8,
                                 interpret=False)
+
+
+def test_validate_slab_threads_caller_budget():
+    """Advisor r5 #1: the LtL slab caller validates against its own
+    model/budget through _validate_slab, so an over-budget LtL shape is
+    rejected with the LtL figures — never the misleading binary '14 MiB
+    budget' message — and a shape inside the LtL budget is never falsely
+    rejected by the binary check."""
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops import pallas_stencil as ps
+
+    bosco = parse_any("bosco")  # r=5 box
+    hr = bosco.radius * 8
+    # oversized explicit block: the raised LtL budget must appear in the
+    # error, proving the threaded budget (not _VMEM_BUDGET) was used
+    with pytest.raises(ValueError) as exc:
+        ps.make_ltl_pallas_slab_step(
+            bosco, Topology.TORUS, (8192 + 2 * hr, 4096), gens=8,
+            block_rows=8192 + 2 * hr, interpret=False)
+    assert f"{ps._ltl_vmem_budget() >> 20} MiB" in str(exc.value)
+
+    # a shape inside the LtL budget passes the threaded check natively
+    # (raising _LTL_VMEM_BUDGET past 49 MiB used to flip such shapes to
+    # a false binary-budget rejection; now the binary default is not
+    # consulted for this caller at all)
+    bh, Wp = 296, 256
+    He = 2 * bh  # = band + 2*hr with hr = 40
+    ltl_model = ps._ltl_vmem_model(bosco.radius)
+    assert ltl_model(bh, hr, Wp) <= ps._LTL_VMEM_BUDGET
+    ps._validate_slab(He, bh, hr, False, Wp=Wp,
+                      vmem_bytes=ltl_model, budget=ps._LTL_VMEM_BUDGET)
+
+
+def test_binary_model_within_budget_whenever_ltl_model_is():
+    """Advisor r5 #1, the coincidence pinned: for every shape the LtL
+    model admits under _LTL_VMEM_BUDGET, the binary model stays under
+    _VMEM_BUDGET (binary <= 2/7 * ltl and 2/7 * 48 MiB < 14 MiB). Any
+    budget/model change breaking this must consciously revisit every
+    _validate_slab caller still using the binary default."""
+    from gameoflifewithactors_tpu.ops import pallas_stencil as ps
+
+    for r in range(1, 8):
+        ltl = ps._ltl_vmem_model(r)
+        for bh in (8, 64, 512, 2048):
+            for g in (8, 16, 40, 56):
+                hr = r * g
+                for Wp in (128, 256, 1024, 4096):
+                    if ltl(bh, hr, Wp) <= ps._LTL_VMEM_BUDGET:
+                        assert ps._vmem_bytes(bh, hr, Wp) <= ps._VMEM_BUDGET, (
+                            r, bh, hr, Wp)
+
+
+def test_tpu_generation_env_override(monkeypatch):
+    """Advisor r5 #3: GOLTPU_TPU_GENERATION names the *target* core, so
+    AOT cross-lowering for a pre-v4 chip can opt into the conservative
+    cap/budget from any host (the host-platform fallback would lift it)."""
+    from gameoflifewithactors_tpu.ops import pallas_stencil as ps
+
+    # conftest forces CPU: the host fallback answers for the v4+ target
+    assert ps._ltl_vmem_limit() == ps._LTL_VMEM_LIMIT
+    for target, want_limit, want_budget in (
+            ("3", 0, ps._VMEM_BUDGET),
+            ("v2", 0, ps._VMEM_BUDGET),
+            ("v5e", ps._LTL_VMEM_LIMIT, ps._LTL_VMEM_BUDGET),
+            ("tpu7x", ps._LTL_VMEM_LIMIT, ps._LTL_VMEM_BUDGET)):
+        monkeypatch.setenv("GOLTPU_TPU_GENERATION", target)
+        assert ps._ltl_vmem_limit() == want_limit, target
+        # the budget keys off the same decision, so block picking and
+        # the requested cap can never disagree under the override either
+        assert ps._ltl_vmem_budget() == want_budget, target
+    monkeypatch.setenv("GOLTPU_TPU_GENERATION", "latest")
+    with pytest.raises(ValueError, match="GOLTPU_TPU_GENERATION"):
+        ps._ltl_vmem_limit()

@@ -10,7 +10,12 @@ fixed tests never use. Example counts are kept modest because every new
 
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# rigs without hypothesis (it is a dev-only dependency) skip this module
+# instead of erroring the whole collection
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from gameoflifewithactors_tpu.models.rules import Rule
 from gameoflifewithactors_tpu.ops import bitpack
