@@ -49,5 +49,10 @@ from .engine import Engine  # noqa: F401
 from .coordinator import GridCoordinator, RenderFrame  # noqa: F401
 from .scheduler import TickScheduler  # noqa: F401
 from .config import SimulationConfig  # noqa: F401
+from .aot import (  # noqa: F401  (warm start: cache + AOT registry + warmup)
+    EngineSpec,
+    ensure_persistent_cache,
+    warmup_specs,
+)
 
 __version__ = "0.1.0"

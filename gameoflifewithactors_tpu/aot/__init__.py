@@ -1,0 +1,52 @@
+"""Warm-start subsystem: make the second process pay ~zero compile time.
+
+Three layers over one motivation (ISSUE 2 / PR 1's telemetry: first-tick
+wall time is compilation, 71 s for one Pallas kernel, >10 min for one
+CPU LtL compile):
+
+- :mod:`.cache` — the **persistent XLA compilation cache**, on by
+  default under ``~/.cache/gameoflifewithactors_tpu/`` (``GOLTPU_CACHE_DIR``
+  env / ``--cache-dir`` to move or disable), thresholds zeroed so every
+  jitted runner round-trips through disk;
+- :mod:`.spec` + :mod:`.registry` — **EngineSpec** canonically hashes a
+  runner configuration with the jax/jaxlib/platform fingerprint, and the
+  **AOT registry** serializes lowered multi-step runners (``jax.export``)
+  so a fresh process loads instead of re-tracing, falling back to JIT
+  (with a warning) on any mismatch;
+- :mod:`.warmup` — the **precompile pipeline** behind the ``warmup`` CLI
+  subcommand: walk a manifest of specs, populate both caches ahead of
+  serving.
+
+Attribution lands in :mod:`..obs.compile`: every compile event carries
+``kind`` ∈ {``cache_miss``, ``cache_hit``, ``aot_loaded``}, and only real
+misses count as compile seconds — a warm RunReport shows
+``compile_seconds`` ≈ 0.
+"""
+
+from .cache import (  # noqa: F401
+    ENV_CACHE_DIR,
+    current_cache_dir,
+    default_cache_root,
+    ensure_persistent_cache,
+    resolve_cache_root,
+)
+from .spec import EngineSpec, environment_fingerprint  # noqa: F401
+from .registry import (  # noqa: F401
+    AotUnsupported,
+    ENV_AOT,
+    aot_enabled,
+    load_runner,
+    maybe_load_for_engine,
+    serialize_engine,
+)
+from .warmup import load_manifest, warmup_spec, warmup_specs  # noqa: F401
+
+__all__ = [
+    "ENV_CACHE_DIR", "ENV_AOT",
+    "current_cache_dir", "default_cache_root", "ensure_persistent_cache",
+    "resolve_cache_root",
+    "EngineSpec", "environment_fingerprint",
+    "AotUnsupported", "aot_enabled", "load_runner", "maybe_load_for_engine",
+    "serialize_engine",
+    "load_manifest", "warmup_spec", "warmup_specs",
+]

@@ -1,0 +1,167 @@
+"""Persistent XLA compilation cache wiring (warm-start layer 1).
+
+PR 1's telemetry made first-tick cost visible: the wall time of a cold
+process is dominated by XLA/Mosaic compilation (71 s for the first native
+``pallas_generations`` compile; one CPU ``backend_compile`` of the R4
+diamond LtL kernel exceeds 10 minutes), and every fresh process pays it
+again for programs that have not changed. JAX ships the fix — a
+disk-backed compilation cache keyed on the serialized computation +
+jaxlib version + compile options — but it is off by default and its
+default thresholds (1 s compile time / 32 KiB entries) skip exactly the
+long tail of small runners this framework compiles. This module turns it
+on, everywhere, with thresholds at zero, so **the second process to
+compile any runner pays a disk read instead of a compile**.
+
+Resolution order for the cache root:
+
+1. an explicit path (``SimulationConfig.cache_dir`` / ``--cache-dir``);
+2. the ``GOLTPU_CACHE_DIR`` environment variable — a path, or one of
+   ``""``/``0``/``off``/``none`` to disable caching entirely;
+3. the default ``~/.cache/gameoflifewithactors_tpu/``.
+
+The XLA cache lives under ``<root>/xla``; the AOT executable registry
+(:mod:`.registry`, layer 2) under ``<root>/aot``. A pre-existing
+user-level ``jax_compilation_cache_dir`` config (or
+``JAX_COMPILATION_CACHE_DIR`` env) is respected and never overridden —
+the user already chose a cache.
+
+``ensure_persistent_cache`` is idempotent and thread-safe; it is called
+from ``Engine.__init__``, the CLI, ``bench.py`` and the ``warmup``
+pipeline, so library users get the warm path without any setup. It also
+registers a ``jax.monitoring`` listener that forwards the cache's
+hit/miss events to :mod:`..obs.compile`, which is what lets a RunReport
+attribute each compile event as ``cache_hit`` vs ``cache_miss``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Optional
+
+ENV_CACHE_DIR = "GOLTPU_CACHE_DIR"
+_DISABLED_VALUES = ("", "0", "off", "none", "disabled")
+
+_lock = threading.Lock()
+_state = {
+    "enabled_dir": None,     # the XLA cache dir we configured, or None
+    "attempted": False,      # ensure_persistent_cache ran at least once
+    "listener_installed": False,
+}
+
+
+def default_cache_root() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "gameoflifewithactors_tpu")
+
+
+def resolve_cache_root(explicit: Optional[str] = None) -> Optional[str]:
+    """The cache root directory, or None when caching is disabled."""
+    if explicit is not None:
+        return explicit or None
+    env = os.environ.get(ENV_CACHE_DIR)
+    if env is not None:
+        if env.strip().lower() in _DISABLED_VALUES:
+            return None
+        return env
+    return default_cache_root()
+
+
+def xla_cache_dir(root: str) -> str:
+    return os.path.join(root, "xla")
+
+
+def aot_registry_dir(root: Optional[str] = None) -> Optional[str]:
+    root = resolve_cache_root() if root is None else root
+    return None if root is None else os.path.join(root, "aot")
+
+
+def _install_listener() -> None:
+    """Forward jax's compilation-cache monitoring events to obs.compile.
+
+    The events fire inside ``backend_compile``: ``cache_hits`` when a
+    compiled executable was served from disk, ``cache_misses`` when a
+    real compile ran (and its result was written back). obs.compile
+    snapshots the counters around each tracked jit call to attribute the
+    call's CompileEvent. Installed once per process."""
+    if _state["listener_installed"]:
+        return
+    from jax._src import monitoring
+
+    from ..obs import compile as obs_compile
+
+    def _on_event(event: str, **kwargs) -> None:
+        if event == "/jax/compilation_cache/cache_hits":
+            obs_compile.note_persistent_cache_event("hit")
+        elif event == "/jax/compilation_cache/cache_misses":
+            obs_compile.note_persistent_cache_event("miss")
+
+    monitoring.register_event_listener(_on_event)
+    _state["listener_installed"] = True
+
+
+def ensure_persistent_cache(explicit: Optional[str] = None) -> Optional[str]:
+    """Enable jax's persistent compilation cache; returns the XLA cache
+    dir in effect, or None when disabled.
+
+    Idempotent: the first call wins (an explicit path on a later call
+    re-points the cache — the CLI parses flags after the first Engine
+    may exist). Never overrides a cache dir the user already configured
+    through jax itself. Failures are a warning, not an error — a
+    read-only home directory must not take an engine down."""
+    import jax
+
+    with _lock:
+        root = resolve_cache_root(explicit)
+        if root is None:
+            _state["attempted"] = True
+            return (jax.config.jax_compilation_cache_dir
+                    if jax.config.jax_compilation_cache_dir else None)
+        pre_existing = jax.config.jax_compilation_cache_dir
+        if pre_existing and pre_existing != _state["enabled_dir"]:
+            # the user (or another library) already chose a cache dir:
+            # respect it, but still lower the thresholds and listen —
+            # warm-start semantics apply to whichever cache is active
+            target = pre_existing
+        else:
+            target = xla_cache_dir(root)
+        if _state["attempted"] and _state["enabled_dir"] == target \
+                and explicit is None:
+            return target
+        try:
+            os.makedirs(target, exist_ok=True)
+            repointing = (jax.config.jax_compilation_cache_dir or "") != target
+            jax.config.update("jax_compilation_cache_dir", target)
+            if repointing:
+                # jax binds its cache handle to the dir at first use and
+                # ignores later config updates; drop the handle so the
+                # new dir actually takes effect (tests re-point per case)
+                try:
+                    from jax._src import compilation_cache as _cc
+
+                    _cc.reset_cache()
+                except Exception:
+                    pass
+            # cache EVERYTHING: the default 1 s / 32 KiB thresholds skip
+            # the long tail of small runners (dozens per engine) whose
+            # re-trace+compile still dominates a cold tick
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            _install_listener()
+            _state["enabled_dir"] = target
+            _state["attempted"] = True
+            return target
+        except Exception as exc:
+            _state["attempted"] = True
+            warnings.warn(
+                f"persistent compilation cache unavailable at {target} "
+                f"({type(exc).__name__}: {exc}); compiles will not be "
+                "cached across processes", RuntimeWarning, stacklevel=2)
+            return None
+
+
+def current_cache_dir() -> Optional[str]:
+    """The XLA cache dir this process configured (None when disabled or
+    not yet enabled)."""
+    return _state["enabled_dir"]

@@ -1,0 +1,255 @@
+"""AOT executable registry (warm-start layer 2): ship compiled runners.
+
+The persistent compilation cache (layer 1, :mod:`.cache`) removes the
+XLA ``backend_compile`` from a warm process but still pays trace +
+lowering of the Python runner every time. This layer removes that too:
+a spec's multi-step runner is lowered once (``jit.lower().compile()``
+semantics via ``jax.export``, which serializes the lowered StableHLO
+module plus calling convention), written under the spec's
+:meth:`~.spec.EngineSpec.cache_key`, and a fresh process deserializes
+and calls it directly — no Python re-trace, and the tiny wrapper module
+that is still XLA-compiled on load rides the layer-1 disk cache.
+
+Registry layout (``<cache_root>/aot/``)::
+
+    <key>.jaxexport   the jax.export blob
+    <key>.json        meta: canonical spec, environment fingerprint,
+                      runner name, state aval, created_at
+
+The key hashes spec + jax/jaxlib version + platform fingerprint, so an
+artifact from another environment is simply not found; when an artifact
+for the same spec exists under a *different* environment, the loader
+names it in a warning and falls back to JIT. Any load failure —
+corrupt blob, deserialization error, changed calling convention — is a
+warning + JIT fallback, never an error: AOT is an optimization, not a
+correctness layer.
+
+Scope: single-device engines on the XLA paths (packed / dense /
+bit-plane / bit-sliced — every family). Sharded engines and the sparse
+backend keep their JIT path (layer 1 still serves them); the Pallas
+kernels are Mosaic-compiled inside XLA and likewise covered by layer 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import warnings
+from typing import Callable, Optional, Tuple
+
+from . import cache as cache_lib
+from .spec import EngineSpec, environment_fingerprint
+
+ENV_AOT = "GOLTPU_AOT"
+_FORMAT_VERSION = 1
+
+
+def aot_enabled() -> bool:
+    return os.environ.get(ENV_AOT, "1").strip().lower() \
+        not in cache_lib._DISABLED_VALUES
+
+
+class AotUnsupported(ValueError):
+    """This engine configuration has no serializable AOT runner."""
+
+
+def _exportable_runner(engine) -> Tuple[Callable, str]:
+    """The ``(state, n) -> state`` jitted callable behind ``engine._run``
+    and its name — the ``optionally_donated`` wrappers expose their
+    underlying jit as ``.jitted`` precisely for this kind of
+    introspection. Raises AotUnsupported for configurations whose runner
+    is not one plain jitted XLA function."""
+    if engine.mesh is not None:
+        raise AotUnsupported(
+            "sharded engines keep the JIT path (the persistent "
+            "compilation cache still warm-starts them)")
+    if engine._sparse is not None:
+        raise AotUnsupported(
+            "the sparse backend's stepper is stateful (activity map + "
+            "overflow handling), not one exportable (state, n) runner")
+    if engine.backend == "pallas":
+        raise AotUnsupported(
+            "pallas runners are Mosaic kernels compiled inside XLA; "
+            "they warm-start through the persistent compilation cache")
+    if engine._ltl_packed:
+        from ..ops.packed_ltl import multi_step_ltl_packed as fn
+    elif engine._ltl_planes:
+        from ..ops.packed_ltl import multi_step_ltl_planes as fn
+    elif engine._ltl:
+        from ..ops.ltl import multi_step_ltl as fn
+    elif engine._gen_packed:
+        from ..ops.packed_generations import multi_step_packed_generations as fn
+    elif engine._generations:
+        from ..ops.generations import multi_step_generations as fn
+    elif engine._packed:
+        from ..ops.packed import multi_step_packed as fn
+    else:
+        from ..ops.stencil import multi_step as fn
+    return fn.jitted, fn.__name__
+
+
+def _paths(key: str, registry_dir: str) -> Tuple[str, str]:
+    return (os.path.join(registry_dir, key + ".jaxexport"),
+            os.path.join(registry_dir, key + ".json"))
+
+
+def serialize_engine(engine, registry_dir: Optional[str] = None) -> str:
+    """Lower + export the engine's multi-step runner and write it under
+    the spec's cache key; returns the blob path. The engine's own state
+    array provides the aval, so the exported module steps exactly the
+    layout the engine runs (packed words / plane stacks / bytes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    registry_dir = registry_dir if registry_dir is not None \
+        else cache_lib.aot_registry_dir()
+    if registry_dir is None:
+        raise ValueError("AOT registry disabled (GOLTPU_CACHE_DIR off)")
+    jitted, runner_name = _exportable_runner(engine)
+    spec = EngineSpec.from_engine(engine)
+    env = environment_fingerprint()
+    key = spec.cache_key(env)
+    state = engine.state
+    exp = jax_export.export(jitted)(
+        jax.ShapeDtypeStruct(state.shape, state.dtype),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        rule=engine.rule, topology=engine.topology)
+    blob = exp.serialize()
+    # execute the EXPORTED form once: a loaded artifact is re-wrapped as
+    # a call_exported module whose persistent-cache key differs from the
+    # original jit's, so without this the first warm process would pay
+    # the whole XLA compile again (measured: the R2 LtL spec's 48 s came
+    # right back). One extra compile here, at warmup time, buys the
+    # ~zero-compile load everywhere else.
+    jax.jit(exp.call)(jnp.zeros_like(state),
+                      jnp.int32(1)).block_until_ready()
+    os.makedirs(registry_dir, exist_ok=True)
+    blob_path, meta_path = _paths(key, registry_dir)
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "spec": spec.canonical(),
+        "env": env,
+        "runner": runner_name,
+        "state_shape": list(state.shape),
+        "state_dtype": str(state.dtype),
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    # blob first, meta last: a meta file is the commit record — a crash
+    # between the writes leaves an orphan blob, never a dangling meta
+    tmp = blob_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, blob_path)
+    with open(meta_path + ".tmp", "w") as f:
+        json.dump(meta, f, indent=1)
+        f.write("\n")
+    os.replace(meta_path + ".tmp", meta_path)
+    return blob_path
+
+
+def _mismatch_candidates(spec: EngineSpec, registry_dir: str) -> list:
+    """Meta records in the registry for this spec under OTHER
+    environments (the version-mismatch warning's evidence)."""
+    want = spec.canonical()
+    out = []
+    try:
+        names = os.listdir(registry_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(registry_dir, name)) as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if meta.get("spec") == want:
+            out.append(meta)
+    return out
+
+
+def load_runner(spec_or_engine, registry_dir: Optional[str] = None,
+                ) -> Optional[Callable]:
+    """Load the AOT runner for a spec/engine; None (after at most one
+    warning) when no loadable artifact exists. The returned callable is
+    ``(state, n) -> state``, jit-wrapped so repeated calls reuse one
+    loaded executable."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from ..obs import compile as obs_compile
+
+    registry_dir = registry_dir if registry_dir is not None \
+        else cache_lib.aot_registry_dir()
+    if registry_dir is None or not os.path.isdir(registry_dir):
+        return None
+    spec = (spec_or_engine if isinstance(spec_or_engine, EngineSpec)
+            else EngineSpec.from_engine(spec_or_engine))
+    if spec.backend == "auto":
+        # artifacts are filed under the RESOLVED backend (serialize_engine
+        # works from a live engine); resolving costs one engine build
+        spec = spec.resolve()
+    env = environment_fingerprint()
+    key = spec.cache_key(env)
+    blob_path, meta_path = _paths(key, registry_dir)
+    if not os.path.exists(meta_path) or not os.path.exists(blob_path):
+        for meta in _mismatch_candidates(spec, registry_dir):
+            got = meta.get("env", {})
+            if got != env:
+                diff = ", ".join(
+                    f"{k}: {got.get(k)!r} != {env.get(k)!r}"
+                    for k in sorted(set(got) | set(env))
+                    if got.get(k) != env.get(k))
+                warnings.warn(
+                    f"AOT artifact for {spec.describe()} exists but was "
+                    f"built for a different environment ({diff}); "
+                    "falling back to JIT (re-run warmup to refresh)",
+                    RuntimeWarning, stacklevel=3)
+                break
+        return None
+    t0 = time.perf_counter()
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("format_version") != _FORMAT_VERSION:
+            raise ValueError(
+                f"registry format {meta.get('format_version')} != "
+                f"{_FORMAT_VERSION}")
+        with open(blob_path, "rb") as f:
+            exp = jax_export.deserialize(f.read())
+        call = jax.jit(exp.call)
+    except Exception as exc:
+        warnings.warn(
+            f"AOT artifact for {spec.describe()} failed to load "
+            f"({type(exc).__name__}: {exc}); falling back to JIT",
+            RuntimeWarning, stacklevel=3)
+        return None
+    obs_compile.record_aot_load(
+        meta.get("runner", "aot"),
+        f"{meta.get('state_dtype')}[{','.join(map(str, meta.get('state_shape', [])))}]",
+        time.perf_counter() - t0)
+
+    def run(state, n):
+        return call(state, jnp.int32(int(n)))
+
+    run.aot_key = key  # introspection: which artifact serves this engine
+    return run
+
+
+def maybe_load_for_engine(engine) -> Optional[Callable]:
+    """Engine-constructor hook: the AOT runner when one is registered for
+    this exact configuration + environment, else None — cheap (one hash
+    + one stat) on the miss path, silent unless an artifact exists but
+    cannot serve."""
+    if not aot_enabled():
+        return None
+    try:
+        _exportable_runner(engine)  # cheap support gate, no tracing
+    except AotUnsupported:
+        return None
+    return load_runner(engine)

@@ -1,0 +1,163 @@
+"""EngineSpec: the canonical identity of a compiled runner (layer 2 keys).
+
+A warm-start artifact — a persistent-cache entry or a serialized AOT
+executable — is only valid for the exact configuration that produced it:
+the rule, grid shape, backend, topology, mesh decomposition and exchange
+depth shape the lowered program, and the jax/jaxlib version plus platform
+fingerprint shape the compiled artifact. ``EngineSpec`` pins the first
+group as one hashable value; :func:`environment_fingerprint` pins the
+second; :meth:`EngineSpec.cache_key` folds both into the content hash the
+AOT registry files executables under, so a stale artifact can never be
+served to a mismatched process — it simply hashes elsewhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional, Tuple
+
+
+def environment_fingerprint() -> dict:
+    """What must match for a compiled artifact to be loadable here:
+    jax + jaxlib versions and the backend platform/device kind/count."""
+    import jax
+    import jaxlib
+
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform
+        device_kind = devs[0].device_kind
+        device_count = len(devs)
+    except Exception:  # no backend (wedged tunnel): still hashable
+        platform, device_kind, device_count = "unknown", "unknown", 0
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+        "device_kind": device_kind,
+        "device_count": device_count,
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """One runner configuration, in engine-constructor vocabulary.
+
+    ``backend`` may be ``"auto"``; hashing canonicalizes through an
+    actual Engine construction (:meth:`resolve`) so two specs that
+    resolve to the same runner share cache entries.
+    """
+
+    height: int
+    width: int
+    rule: str = "B3/S23"
+    backend: str = "auto"
+    topology: str = "torus"
+    mesh: Optional[Tuple[int, int]] = None   # (nx, ny) device mesh, or None
+    gens_per_exchange: int = 1
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EngineSpec":
+        d = dict(d)
+        if "shape" in d:  # manifest convenience: "shape": [H, W]
+            d["height"], d["width"] = d.pop("shape")
+        mesh = d.get("mesh")
+        if mesh is not None:
+            d["mesh"] = tuple(int(x) for x in mesh)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown EngineSpec fields {sorted(unknown)}; "
+                f"expected a subset of {sorted(known)} (or 'shape')")
+        return cls(**d)
+
+    @classmethod
+    def from_config(cls, cfg) -> "EngineSpec":
+        """From a SimulationConfig (the CLI's ``warmup --from-config``)."""
+        mesh = None
+        m = cfg.build_mesh()
+        if m is not None:
+            from ..parallel import mesh as mesh_lib
+
+            mesh = (m.shape[mesh_lib.ROW_AXIS], m.shape[mesh_lib.COL_AXIS])
+        return cls(height=cfg.height, width=cfg.width, rule=cfg.rule,
+                   backend=cfg.backend, topology=cfg.topology, mesh=mesh,
+                   gens_per_exchange=cfg.gens_per_exchange)
+
+    @classmethod
+    def from_engine(cls, engine) -> "EngineSpec":
+        """From a live Engine — ``backend`` is the RESOLVED one, so the
+        spec round-trips to the same runner the engine actually built."""
+        from ..parallel import mesh as mesh_lib
+
+        mesh = None
+        if engine.mesh is not None:
+            mesh = (engine.mesh.shape[mesh_lib.ROW_AXIS],
+                    engine.mesh.shape[mesh_lib.COL_AXIS])
+        return cls(height=engine.shape[0], width=engine.shape[1],
+                   rule=engine.rule.notation, backend=engine.backend,
+                   topology=engine.topology.value, mesh=mesh,
+                   gens_per_exchange=engine.gens_per_exchange)
+
+    # -- engine assembly -----------------------------------------------------
+
+    def build_engine(self, grid=None):
+        """Construct the Engine this spec names (all-dead universe by
+        default — compilation depends on shapes/dtypes, never on cell
+        values, so warmup and AOT serialization need no seed)."""
+        import numpy as np
+
+        from ..engine import Engine
+        from ..ops.stencil import Topology
+        from ..parallel import mesh as mesh_lib
+
+        if grid is None:
+            grid = np.zeros((self.height, self.width), dtype=np.uint8)
+        mesh = mesh_lib.make_mesh(self.mesh) if self.mesh else None
+        return Engine(grid, self.rule, topology=Topology(self.topology),
+                      mesh=mesh, backend=self.backend,
+                      gens_per_exchange=self.gens_per_exchange)
+
+    def resolve(self) -> "EngineSpec":
+        """The spec with ``backend`` (and ``gens_per_exchange``, which
+        the band runners normalize) resolved through a real Engine
+        construction — the canonical form the registry hashes."""
+        if self.backend != "auto":
+            return self
+        return EngineSpec.from_engine(self.build_engine())
+
+    # -- identity ------------------------------------------------------------
+
+    def canonical(self) -> dict:
+        """Canonical rule notation + sorted fields, environment excluded."""
+        from ..models.generations import parse_any
+
+        d = dataclasses.asdict(self)
+        d["rule"] = parse_any(self.rule).notation
+        if d["mesh"] is not None:
+            d["mesh"] = list(d["mesh"])
+        return d
+
+    def cache_key(self, fingerprint: Optional[dict] = None) -> str:
+        """Content hash naming this spec's artifacts: canonical spec +
+        environment fingerprint, sha256-hex (first 24 chars — plenty
+        against collision across a registry of hand-counted specs)."""
+        payload = {
+            "spec": self.canonical(),
+            "env": fingerprint if fingerprint is not None
+            else environment_fingerprint(),
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:24]
+
+    def describe(self) -> str:
+        mesh = f" mesh={self.mesh[0]}x{self.mesh[1]}" if self.mesh else ""
+        g = (f" G={self.gens_per_exchange}"
+             if self.gens_per_exchange != 1 else "")
+        return (f"{self.rule} {self.height}x{self.width} "
+                f"[{self.backend}/{self.topology}{mesh}{g}]")

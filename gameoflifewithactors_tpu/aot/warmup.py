@@ -1,0 +1,102 @@
+"""Warmup precompile pipeline (layer 3): populate the caches before serving.
+
+``python -m gameoflifewithactors_tpu warmup --manifest specs.json`` (or
+``--from-config`` + the normal CLI flags) builds each spec's engine and
+steps it through every runner signature the serving process will hit —
+the single-generation call and the bulk chunk call — so the persistent
+compilation cache holds all of them; with ``--aot`` (default) it also
+serializes the runner into the AOT registry. A fleet rollout runs this
+once per (jax version × platform) before taking traffic; CI runs it
+implicitly by caching the cache dir across runs (tier1.yml).
+
+The manifest is a JSON list of EngineSpec dicts::
+
+    [{"rule": "B3/S23", "shape": [4096, 4096], "backend": "packed"},
+     {"rule": "brain", "shape": [1024, 1024], "backend": "packed"},
+     {"rule": "R2,C0,M1,S2..6,B3..5,NM", "shape": [512, 512],
+      "backend": "packed", "topology": "dead"}]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from . import cache as cache_lib
+from . import registry as registry_lib
+from .spec import EngineSpec
+
+
+def load_manifest(path: str) -> List[EngineSpec]:
+    with open(path) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError(
+            f"manifest {path} must be a JSON list of spec objects")
+    return [EngineSpec.from_dict(e) for e in entries]
+
+
+def warmup_spec(spec: EngineSpec, *, aot: bool = True) -> dict:
+    """Precompile one spec: build its engine, exercise the per-generation
+    and bulk runner signatures, optionally serialize the AOT runner.
+    Returns a report row (wall/compile seconds, event kinds, aot status).
+    """
+    from ..obs import compile as obs_compile
+
+    log = obs_compile.COMPILE_LOG
+    n_before = len(log.events())
+    t0 = time.perf_counter()
+    engine = spec.build_engine()
+    # both signatures the serving process uses: one generation (the
+    # remainder path) and a bulk chunk (> gens_per_exchange, so chunked
+    # runners compile their deep runner too)
+    engine.step(1)
+    bulk = max(2, engine.gens_per_exchange + 1)
+    engine.step(bulk)
+    engine.block_until_ready()
+    aot_status: Optional[str] = None
+    if aot:
+        try:
+            registry_lib.serialize_engine(engine)
+            aot_status = "serialized"
+        except registry_lib.AotUnsupported as exc:
+            aot_status = f"unsupported: {exc}"
+        except Exception as exc:  # pragma: no cover - env-dependent
+            aot_status = f"failed: {type(exc).__name__}: {exc}"
+    wall = time.perf_counter() - t0
+    events = log.events()[n_before:]
+    kinds: dict = {}
+    for e in events:
+        kinds[e.kind] = kinds.get(e.kind, 0) + 1
+    return {
+        "spec": spec.canonical(),
+        "resolved_backend": engine.backend,
+        "wall_seconds": wall,
+        "compile_seconds": sum(e.wall_seconds for e in events
+                               if e.kind == "cache_miss"),
+        "events": kinds,
+        "aot": aot_status,
+    }
+
+
+def warmup_specs(specs, *, aot: bool = True, cache_dir: Optional[str] = None,
+                 verbose=None) -> List[dict]:
+    """The pipeline: enable the persistent cache, then warm every spec.
+    ``verbose`` is a print-like callable for progress lines (or None)."""
+    enabled = cache_lib.ensure_persistent_cache(cache_dir)
+    if verbose:
+        verbose(f"persistent compilation cache: {enabled or 'DISABLED'}")
+    rows = []
+    for spec in specs:
+        if verbose:
+            verbose(f"warming {spec.describe()} ...")
+        row = warmup_spec(spec, aot=aot)
+        rows.append(row)
+        if verbose:
+            verbose(
+                f"  {row['wall_seconds']:.2f}s wall, "
+                f"{row['compile_seconds']:.2f}s compiling, "
+                f"events {row['events'] or '{}'}"
+                + (f", aot: {row['aot']}" if row["aot"] else ""))
+    return rows

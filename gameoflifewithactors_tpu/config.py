@@ -48,6 +48,9 @@ class SimulationConfig:
     save_rle: Optional[str] = None          # final state as RLE (binary rules)
     telemetry_out: Optional[str] = None     # RunReport JSON path (obs/)
     stall_deadline: Optional[float] = None  # watchdog deadline seconds
+    cache_dir: Optional[str] = None         # warm-start cache root (aot/);
+    #                                         None = GOLTPU_CACHE_DIR env or
+    #                                         ~/.cache/gameoflifewithactors_tpu
 
     # -- assembly ------------------------------------------------------------
 
@@ -104,11 +107,15 @@ class SimulationConfig:
 
     def build(self):
         """Construct the full (coordinator, scheduler) stack."""
+        from .aot import cache as aot_cache
         from .coordinator import GridCoordinator
         from .models import seeds as seeds_lib
         from .scheduler import TickScheduler
         from .utils import checkpoint as ckpt_lib
 
+        # before any engine exists, so an explicit --cache-dir governs
+        # every compile of the run (Engine re-ensures idempotently)
+        aot_cache.ensure_persistent_cache(self.cache_dir)
         topology = Topology(self.topology)
         mesh = self.build_mesh()
         if self.resume:
@@ -223,6 +230,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "events, StepMetrics, halo-byte figures, stalls "
                         "(see README 'Observability'; inspect with the "
                         "'report' subcommand)")
+    p.add_argument("--cache-dir", default=None, metavar="PATH",
+                   help="warm-start cache root (persistent XLA compile "
+                        "cache + AOT registry; README 'Warm start'). "
+                        "Default: $GOLTPU_CACHE_DIR, else "
+                        "~/.cache/gameoflifewithactors_tpu; pass '' to "
+                        "disable caching for this run")
     p.add_argument("--stall-deadline", type=float, default=None, metavar="S",
                    help="with --telemetry-out: flag any tick exceeding S "
                         "seconds, naming the last-completed span "
@@ -267,5 +280,6 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         save_rle=args.save_rle,
         telemetry_out=args.telemetry_out,
         stall_deadline=args.stall_deadline,
+        cache_dir=args.cache_dir,
     )
     return cfg, args

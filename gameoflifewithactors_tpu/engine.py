@@ -130,6 +130,12 @@ class Engine:
         if backend not in BACKENDS and backend != "auto":
             raise ValueError(
                 f"backend must be 'auto' or one of {BACKENDS}, got {backend!r}")
+        # warm start (aot/): every compile below this point round-trips
+        # through the persistent disk cache (GOLTPU_CACHE_DIR; default
+        # ~/.cache/gameoflifewithactors_tpu/) — idempotent, a few µs warm
+        from .aot import cache as aot_cache
+
+        aot_cache.ensure_persistent_cache()
         self.rule = parse_any(rule)
         from .models.elementary import ElementaryRule
 
@@ -575,6 +581,23 @@ class Engine:
                 s, n, rule=self.rule, topology=self.topology, donate=True
             )
         self._state = state
+        # warm start layer 2: when the AOT registry holds a serialized
+        # runner for this exact (spec, jax/jaxlib, platform), load it in
+        # place of the JIT path — no re-trace, and the loader's wrapper
+        # compile rides the persistent cache. One hash + one stat when
+        # nothing is registered; any load problem warns and keeps JIT.
+        # Note the AOT path does not donate its input buffer (jax.export
+        # has no donation contract), so it holds two state buffers in
+        # memory — irrelevant on host-sized grids, and an engine that
+        # needs in-place double-buffering can opt out via GOLTPU_AOT=0.
+        self.aot_loaded = False
+        if self._sparse is None:
+            from .aot import registry as aot_registry
+
+            aot_run = aot_registry.maybe_load_for_engine(self)
+            if aot_run is not None:
+                self._run = aot_run
+                self.aot_loaded = True
 
     def _flagged_sparse_runner(self, run2, mesh: Mesh):
         """Wrap a sharded sparse runner (binary bitboard or Generations

@@ -88,10 +88,21 @@ class RunReport:
                 lines.append(
                     f"  {name:{width}}  {rec['total_s']:10.4f}s"
                     f"  x{rec['count']:<6} mean {rec['mean_s']:.4f}s")
-        misses = [e for e in self.compile_events if e.get("cache_miss")]
+        # warm-start attribution (aot/): real compiles vs persistent-cache
+        # hits vs AOT loads; pre-`kind` reports only recorded real misses
+        kind_of = lambda e: e.get(  # noqa: E731 - local classifier
+            "kind", "cache_miss" if e.get("cache_miss") else "cache_hit")
+        misses = [e for e in self.compile_events
+                  if kind_of(e) == "cache_miss"]
+        hits = sum(1 for e in self.compile_events
+                   if kind_of(e) == "cache_hit")
+        aot = sum(1 for e in self.compile_events
+                  if kind_of(e) == "aot_loaded")
+        warm = (f", {hits} cache-hit" if hits else "") + \
+               (f", {aot} aot-loaded" if aot else "")
         lines.append(
             f"compiles: {len(misses)} "
-            f"({self.compile_seconds_total:.2f}s total)")
+            f"({self.compile_seconds_total:.2f}s total){warm}")
         for e in misses:
             lines.append(f"  {e['wall_seconds']:8.3f}s  {e['runner']}"
                          f"({e['signature']})")

@@ -1,0 +1,218 @@
+"""Warm-start evidence: cold-process vs warm-process wall seconds (CPU).
+
+The acceptance artifact for the aot/ subsystem (ISSUE 2): for three
+representative specs — the binary packed path (what the pallas backend
+falls back to off-TPU), the Generations bit-plane stack, and the
+bit-sliced binary LtL path — run the same engine-build + step + sync in
+a fresh subprocess twice against one warm-start cache dir. The first
+(cold) process pays trace + XLA compile and populates the persistent
+compilation cache + AOT registry; the second (warm) process must come in
+at <= 50% of the cold wall time, with its compile events attributed
+``cache_hit`` / ``aot_loaded`` and ``compile_seconds`` ~ 0.
+
+Writes ``results/warmstart_cpu.json`` (the scoreboard record) and
+``results/warmstart_warm_report.json`` (the warm run's full RunReport,
+the "compile time disappeared" receipt). Stdlib-only parent, bench.py's
+subprocess pattern: safe to run while the TPU tunnel is wedged.
+
+Usage: python scripts/warm_vs_cold.py [--keep-cache DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the measured run: build + first-use stepping of both runner signatures,
+# the exact shape of a serving process's first tick
+SPECS = [
+    {"name": "binary-packed (pallas CPU-fallback path)",
+     "spec": {"rule": "B3/S23", "shape": [512, 512], "backend": "packed"}},
+    {"name": "generations-planes (brain)",
+     "spec": {"rule": "brain", "shape": [512, 512], "backend": "packed"}},
+    {"name": "ltl-bit-sliced (R2 box)",
+     "spec": {"rule": "R2,C0,M1,S2..6,B3..5,NM", "shape": [512, 512],
+              "backend": "packed"}},
+]
+CHILD_TIMEOUT_S = float(os.environ.get("WARMSTART_CHILD_TIMEOUT_S", "600"))
+
+
+def _provenance():
+    import importlib.util
+
+    path = os.path.join(REPO, "gameoflifewithactors_tpu", "utils",
+                        "provenance.py")
+    spec = importlib.util.spec_from_file_location("_wvc_provenance", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def child(spec_json: str, report_out: str | None) -> None:
+    """One measured process: enable the cache (env-driven), build the
+    spec's engine, exercise both runner signatures, serialize the AOT
+    runner, report wall + compile attribution as one JSON line."""
+    sys.path.insert(0, REPO)
+    import axon_guard
+
+    axon_guard.force_cpu(1)
+
+    from gameoflifewithactors_tpu.aot import EngineSpec, serialize_engine
+    from gameoflifewithactors_tpu.aot import registry as aot_registry
+    from gameoflifewithactors_tpu.obs import COMPILE_LOG
+
+    spec = EngineSpec.from_dict(json.loads(spec_json))
+    t0 = time.perf_counter()
+    engine = spec.build_engine()
+    engine.step(1)
+    engine.step(max(2, engine.gens_per_exchange + 1))
+    engine.block_until_ready()
+    wall = time.perf_counter() - t0
+    try:
+        serialize_engine(engine)
+    except aot_registry.AotUnsupported:
+        pass
+    events = COMPILE_LOG.events()
+    kinds: dict = {}
+    for e in events:
+        kinds[e.kind] = kinds.get(e.kind, 0) + 1
+    if report_out:
+        from gameoflifewithactors_tpu.obs.report import build_run_report
+
+        build_run_report(
+            engine=engine,
+            config={"warm_vs_cold": True, "spec": spec.canonical()},
+        ).save(report_out)
+    print(json.dumps({
+        "wall_seconds": wall,
+        "compile_seconds": COMPILE_LOG.total_compile_seconds(),
+        "events": kinds,
+        "aot_loaded": engine.aot_loaded,
+    }))
+
+
+def run_child(spec: dict, cache_dir: str, report_out: str | None,
+              aot: bool = True) -> dict:
+    sys.path.insert(0, REPO)
+    import axon_guard
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "GOLTPU_CACHE_DIR": cache_dir,
+           "GOLTPU_AOT": "1" if aot else "0",
+           "PYTHONPATH": axon_guard.strip_pythonpath()}
+    cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
+           json.dumps(spec)]
+    if report_out:
+        cmd += ["--report-out", report_out]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       timeout=CHILD_TIMEOUT_S)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"child failed (rc={r.returncode})")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--run-child", metavar="SPEC_JSON", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--report-out", metavar="PATH", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--keep-cache", metavar="DIR", default=None,
+                    help="use (and keep) this cache dir instead of a "
+                         "throwaway temp dir")
+    args = ap.parse_args()
+    if args.run_child:
+        child(args.run_child, args.report_out)
+        return
+
+    cache_dir = args.keep_cache or tempfile.mkdtemp(prefix="goltpu-wvc-")
+    results_dir = os.path.join(REPO, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    warm_report_path = os.path.join(results_dir, "warmstart_warm_report.json")
+    rows = []
+    try:
+        for item in SPECS:
+            name, spec = item["name"], item["spec"]
+            sys.stderr.write(f"[cold] {name} ...\n")
+            cold = run_child(spec, cache_dir, None)
+            sys.stderr.write(
+                f"    {cold['wall_seconds']:.2f}s "
+                f"({cold['compile_seconds']:.2f}s compiling)\n[warm] "
+                f"{name} ...\n")
+            warm = run_child(spec, cache_dir, None)
+            sys.stderr.write(
+                f"    {warm['wall_seconds']:.2f}s "
+                f"({warm['compile_seconds']:.2f}s compiling), events "
+                f"{warm['events']}, aot_loaded={warm['aot_loaded']}\n")
+            rows.append({
+                "name": name, "spec": spec,
+                "cold_wall_seconds": cold["wall_seconds"],
+                "cold_compile_seconds": cold["compile_seconds"],
+                "warm_wall_seconds": warm["wall_seconds"],
+                "warm_compile_seconds": warm["compile_seconds"],
+                "warm_events": warm["events"],
+                "warm_aot_loaded": warm["aot_loaded"],
+                "warm_over_cold": warm["wall_seconds"] / cold["wall_seconds"],
+            })
+        # one more warm run with AOT loading off: layer 1 alone — the
+        # re-jitted runners must come back as cache_hit events with zero
+        # compile seconds; its RunReport is the committed receipt
+        sys.stderr.write("[warm, GOLTPU_AOT=0] "
+                         f"{SPECS[0]['name']} ...\n")
+        layer1 = run_child(SPECS[0]["spec"], cache_dir, warm_report_path,
+                           aot=False)
+        sys.stderr.write(
+            f"    {layer1['wall_seconds']:.2f}s "
+            f"({layer1['compile_seconds']:.2f}s compiling), events "
+            f"{layer1['events']}\n")
+    finally:
+        if not args.keep_cache:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    total_cold = sum(r["cold_wall_seconds"] for r in rows)
+    total_warm = sum(r["warm_wall_seconds"] for r in rows)
+    prov = _provenance()
+    record = {
+        "metric": "warm-start: warm-process / cold-process wall time, "
+                  "3 representative specs (cpu)",
+        "value": total_warm / total_cold,
+        "unit": "warm/cold wall ratio",
+        "ok": total_warm <= 0.5 * total_cold,
+        "target": "<= 0.5 (ISSUE 2 acceptance)",
+        "total_cold_seconds": total_cold,
+        "total_warm_seconds": total_warm,
+        "specs": rows,
+        "layer1_only_warm": {
+            "spec": SPECS[0]["spec"],
+            "wall_seconds": layer1["wall_seconds"],
+            "compile_seconds": layer1["compile_seconds"],
+            "events": layer1["events"],
+        },
+        "warm_report": os.path.relpath(warm_report_path, REPO),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **prov.head_stamp(paths=["gameoflifewithactors_tpu/aot",
+                                 "gameoflifewithactors_tpu/ops",
+                                 "gameoflifewithactors_tpu/engine.py",
+                                 "gameoflifewithactors_tpu/obs/compile.py",
+                                 "scripts/warm_vs_cold.py"]),
+    }
+    out = os.path.join(results_dir, "warmstart_cpu.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: record[k] for k in
+                      ("metric", "value", "unit", "ok")}))
+    sys.stderr.write(f"written: {out}\n")
+
+
+if __name__ == "__main__":
+    main()

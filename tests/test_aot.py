@@ -1,0 +1,305 @@
+"""Warm-start subsystem tests (aot/): cache wiring, EngineSpec identity,
+AOT serialize/deserialize round-trips, compile-event attribution, and the
+StepMetrics compile_seconds == 0 regression for cache-hit runs.
+
+Everything runs against throwaway cache dirs (the ``cold_compile_cache``
+fixture / monkeypatched ``GOLTPU_CACHE_DIR``) — the session-level cache
+tests/conftest.py sets up must never make these tests order-dependent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.aot import (
+    EngineSpec,
+    cache as aot_cache,
+    registry as aot_registry,
+    warmup as aot_warmup,
+)
+from gameoflifewithactors_tpu.obs import compile as obs_compile
+
+
+def _soup(shape=(64, 64), states=2, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, states, size=shape, dtype=np.uint8)
+
+
+# -- layer 1: the persistent compilation cache --------------------------------
+
+
+def test_resolve_cache_root_precedence(monkeypatch, tmp_path):
+    monkeypatch.delenv(aot_cache.ENV_CACHE_DIR, raising=False)
+    assert aot_cache.resolve_cache_root() == aot_cache.default_cache_root()
+    monkeypatch.setenv(aot_cache.ENV_CACHE_DIR, str(tmp_path / "env"))
+    assert aot_cache.resolve_cache_root() == str(tmp_path / "env")
+    # an explicit path beats the env; empty-string explicit disables
+    assert aot_cache.resolve_cache_root(str(tmp_path / "x")) == str(tmp_path / "x")
+    assert aot_cache.resolve_cache_root("") is None
+    # the documented off-switch spellings
+    for off in ("", "0", "off", "none", "OFF"):
+        monkeypatch.setenv(aot_cache.ENV_CACHE_DIR, off)
+        assert aot_cache.resolve_cache_root() is None
+
+
+def test_ensure_persistent_cache_points_jax_at_the_dir(cold_compile_cache):
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == os.path.join(
+        cold_compile_cache, "xla")
+    assert aot_cache.current_cache_dir() == os.path.join(
+        cold_compile_cache, "xla")
+    # zeroed thresholds: every runner is cacheable, not just the slow tail
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+
+
+def test_compile_lands_in_the_cache_dir(cold_compile_cache):
+    from gameoflifewithactors_tpu.engine import Engine
+
+    eng = Engine(_soup(), "B3/S23", backend="packed")
+    eng.step(3)
+    eng.block_until_ready()
+    entries = os.listdir(os.path.join(cold_compile_cache, "xla"))
+    assert any(n.endswith("-cache") for n in entries), \
+        "the engine's compiles must round-trip through the disk cache"
+
+
+def test_cache_hit_attribution_after_clear(cold_compile_cache):
+    """The attribution at the heart of the warm path: a jit-cache miss
+    whose executable came from the persistent disk cache is a
+    ``cache_hit`` event, and contributes ZERO compile seconds."""
+    import jax
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.ops._jit import optionally_donated
+
+    @optionally_donated("p", static=())
+    def _aot_probe(p):
+        return (p << 1) ^ p
+
+    log = obs_compile.CompileEventLog()
+    x = jnp.full((8, 8), 3, jnp.uint32)
+    obs_compile.tracked_call(_aot_probe.jitted, "_aot_probe", (x,), {},
+                             log=log)
+    ev = log.events()[0]
+    assert ev.kind == "cache_miss" and ev.cache_miss
+    # a fresh process re-traces but reads the executable from disk;
+    # jax.clear_caches() reproduces that state in-process
+    jax.clear_caches()
+    obs_compile.tracked_call(_aot_probe.jitted, "_aot_probe", (x,), {},
+                             log=log)
+    warm = log.events()[-1]
+    assert warm.kind == "cache_hit" and not warm.cache_miss
+    # only the real compile counts toward compile seconds
+    assert log.total_compile_seconds() == pytest.approx(ev.wall_seconds)
+
+
+# -- EngineSpec identity ------------------------------------------------------
+
+
+def test_spec_canonical_and_key():
+    a = EngineSpec(height=64, width=64, rule="conway", backend="packed")
+    b = EngineSpec(height=64, width=64, rule="B3/S23", backend="packed")
+    env = {"jax": "x", "jaxlib": "y", "platform": "cpu",
+           "device_kind": "cpu", "device_count": 1}
+    # named and notation spellings of one rule share artifacts
+    assert a.canonical() == b.canonical()
+    assert a.cache_key(env) == b.cache_key(env)
+    # any spec field or environment field re-keys
+    c = EngineSpec(height=64, width=96, rule="B3/S23", backend="packed")
+    assert c.cache_key(env) != a.cache_key(env)
+    assert a.cache_key({**env, "jaxlib": "z"}) != a.cache_key(env)
+
+
+def test_spec_from_dict_shapes_and_errors():
+    s = EngineSpec.from_dict({"rule": "brain", "shape": [128, 256]})
+    assert (s.height, s.width) == (128, 256) and s.backend == "auto"
+    with pytest.raises(ValueError, match="unknown EngineSpec fields"):
+        EngineSpec.from_dict({"shape": [8, 8], "gird": "typo"})
+
+
+def test_spec_engine_round_trip():
+    spec = EngineSpec(height=64, width=64, rule="B3/S23", backend="auto")
+    resolved = spec.resolve()
+    assert resolved.backend in ("packed", "pallas")
+    eng = resolved.build_engine()
+    assert EngineSpec.from_engine(eng) == resolved
+
+
+# -- layer 2: AOT serialize -> fresh-process-style deserialize -> step --------
+
+
+@pytest.mark.parametrize("rule,states", [
+    ("B3/S23", 2),          # binary packed words
+    ("brain", 3),           # Generations bit-plane stack
+])
+def test_aot_round_trip_bit_identity(rule, states, cold_compile_cache,
+                                     monkeypatch):
+    """serialize -> deserialize (jit caches dropped, as in a fresh
+    process) -> step must be bit-identical with the JIT path."""
+    import jax
+
+    spec = EngineSpec(height=64, width=64, rule=rule, backend="packed")
+    grid = _soup(states=states)
+    jit_eng = spec.build_engine(grid)
+    assert not jit_eng.aot_loaded  # nothing registered yet
+    aot_registry.serialize_engine(jit_eng)
+    jit_eng.step(7)
+    ref = jit_eng.snapshot()
+
+    jax.clear_caches()  # fresh-process stand-in: no live executables
+    aot_eng = spec.build_engine(grid)
+    assert aot_eng.aot_loaded, "registered artifact must be picked up"
+    assert getattr(aot_eng._run, "aot_key", None)
+    aot_eng.step(7)
+    np.testing.assert_array_equal(aot_eng.snapshot(), ref)
+
+    # the off-switch keeps the JIT path
+    monkeypatch.setenv(aot_registry.ENV_AOT, "0")
+    off = spec.build_engine(grid)
+    assert not off.aot_loaded
+
+
+def test_aot_load_records_event(cold_compile_cache):
+    spec = EngineSpec(height=64, width=64, rule="B3/S23", backend="packed")
+    eng = spec.build_engine()
+    aot_registry.serialize_engine(eng)
+    obs_compile.COMPILE_LOG.clear()
+    loaded = spec.build_engine()
+    assert loaded.aot_loaded
+    kinds = [e.kind for e in obs_compile.COMPILE_LOG.events()]
+    assert "aot_loaded" in kinds
+    # an AOT load is not compile time
+    assert obs_compile.COMPILE_LOG.total_compile_seconds() == 0.0
+
+
+def test_aot_corrupt_artifact_falls_back_with_warning(cold_compile_cache):
+    spec = EngineSpec(height=64, width=64, rule="B3/S23", backend="packed")
+    eng = spec.build_engine()
+    blob_path = aot_registry.serialize_engine(eng)
+    with open(blob_path, "wb") as f:
+        f.write(b"not a jax.export blob")
+    with pytest.warns(RuntimeWarning, match="failed to load"):
+        assert aot_registry.load_runner(spec) is None
+    fresh = spec.build_engine()  # engine constructor takes the same path
+    assert not fresh.aot_loaded
+
+
+def test_aot_environment_mismatch_warns(cold_compile_cache):
+    spec = EngineSpec(height=64, width=64, rule="B3/S23", backend="packed")
+    eng = spec.build_engine()
+    aot_registry.serialize_engine(eng)
+    reg = aot_cache.aot_registry_dir()
+    (meta_name,) = [n for n in os.listdir(reg) if n.endswith(".json")]
+    meta = json.load(open(os.path.join(reg, meta_name)))
+    meta["env"]["jaxlib"] = "0.0.0-elsewhere"
+    other_key = "f" * 24
+    json.dump(meta, open(os.path.join(reg, other_key + ".json"), "w"))
+    # drop the matching artifact so only the foreign-env one remains
+    for n in (meta_name, meta_name.replace(".json", ".jaxexport")):
+        os.remove(os.path.join(reg, n))
+    with pytest.warns(RuntimeWarning, match="different environment"):
+        assert aot_registry.load_runner(spec) is None
+
+
+def test_aot_unsupported_configs_raise_and_skip():
+    from gameoflifewithactors_tpu.engine import Engine
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    sharded = Engine(_soup((64, 64)), "B3/S23",
+                     mesh=mesh_lib.make_mesh((8, 1)), backend="packed")
+    with pytest.raises(aot_registry.AotUnsupported, match="sharded"):
+        aot_registry._exportable_runner(sharded)
+    assert aot_registry.maybe_load_for_engine(sharded) is None
+    sparse = Engine(_soup((64, 64)), "B3/S23", backend="sparse")
+    with pytest.raises(aot_registry.AotUnsupported, match="sparse"):
+        aot_registry._exportable_runner(sparse)
+
+
+# -- the StepMetrics regression: compile_seconds == 0 on a cache-hit run ------
+
+
+def test_step_metrics_zero_compile_on_cache_hit_run(cold_compile_cache):
+    """ISSUE-2 regression: when every executable comes from the
+    persistent cache, the tick's StepMetrics must report no compile
+    seconds — the warm path's whole claim, in the metric users watch."""
+    import jax
+
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+    from gameoflifewithactors_tpu.utils.metrics import BufferSink, MetricsLogger
+
+    cold_buf = BufferSink()
+    coord = GridCoordinator((48, 64), "B36/S125", random_fill=0.3,
+                            backend="packed",
+                            metrics=MetricsLogger(cold_buf))
+    coord.tick(2)
+    assert cold_buf.records[0].compile_seconds, \
+        "cold run must pay (and report) the compile"
+
+    jax.clear_caches()  # fresh-process stand-in
+    warm_buf = BufferSink()
+    coord2 = GridCoordinator((48, 64), "B36/S125", random_fill=0.3,
+                             backend="packed",
+                             metrics=MetricsLogger(warm_buf))
+    t0 = time.perf_counter()
+    coord2.tick(2)
+    t1 = time.perf_counter()
+    rec = warm_buf.records[0]
+    assert rec.compile_seconds is None  # == 0 in the serialized record
+    # ... and not because nothing happened: the runner DID re-enter the
+    # jit cache inside this tick, served from disk
+    hits = [e for e in obs_compile.COMPILE_LOG.events()
+            if e.kind == "cache_hit" and t0 <= e.t1 <= t1]
+    assert hits, "the warm tick must record its cache_hit attribution"
+
+
+# -- layer 3: the warmup pipeline ---------------------------------------------
+
+
+def test_warmup_specs_populates_both_layers(cold_compile_cache):
+    import jax
+
+    jax.clear_caches()  # earlier tests may hold this runner in-memory
+    specs = [EngineSpec(height=64, width=64, rule="B3/S23",
+                        backend="packed")]
+    rows = aot_warmup.warmup_specs(specs, verbose=None)
+    assert rows[0]["aot"] == "serialized"
+    assert rows[0]["resolved_backend"] == "packed"
+    xla = os.listdir(os.path.join(cold_compile_cache, "xla"))
+    assert any(n.endswith("-cache") for n in xla)
+    reg = os.listdir(os.path.join(cold_compile_cache, "aot"))
+    assert any(n.endswith(".jaxexport") for n in reg)
+    assert any(n.endswith(".json") for n in reg)
+
+
+def test_warmup_manifest_loader(tmp_path):
+    path = tmp_path / "manifest.json"
+    path.write_text(json.dumps([
+        {"rule": "B3/S23", "shape": [64, 64], "backend": "packed"},
+        {"rule": "brain", "height": 64, "width": 64},
+    ]))
+    specs = aot_warmup.load_manifest(str(path))
+    assert [s.rule for s in specs] == ["B3/S23", "brain"]
+    path.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        aot_warmup.load_manifest(str(path))
+
+
+def test_warmup_cli_from_config(cold_compile_cache, capsys):
+    from gameoflifewithactors_tpu import cli
+
+    rc = cli.main(["warmup", "--from-config", "--json", "--no-aot",
+                   "--grid", "64x64", "--rule", "B3/S23",
+                   "--backend", "packed"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["warmup"] and len(out["specs"]) == 1
+    assert out["specs"][0]["spec"]["rule"] == "B3/S23"
+    with pytest.raises(SystemExit):  # exactly one mode is required
+        cli.main(["warmup"])

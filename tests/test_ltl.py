@@ -244,7 +244,16 @@ class TestVonNeumann:
         # backend_compile of the R4 diamond packed kernel (verified by a
         # faulthandler stack dump — compile, not deadlock), which blows
         # the tier-1 budget; R1/R2 keep the packed-diamond path covered
-        # there, and full/TPU runs still exercise R4
+        # there, and full/TPU runs still exercise R4.
+        # Re-examined for ISSUE 2 (2026-08-04) with the aot/ persistent
+        # compile cache active: the COLD compile burned >21 CPU-minutes
+        # on this 1-core host before being stopped unfinished, so the
+        # cache (which only helps the SECOND run) cannot bring the param
+        # under the 870 s tier-1 budget — local tier-1 is hermetically
+        # cold by design (tests/conftest.py pins a fresh cache dir per
+        # session), and a CI run that must first pay the >21 min cold
+        # compile blows tier1.yml's 30-min job budget before its
+        # actions/cache entry ever exists. The mark stays.
         pytest.param("R4,C0,M1,S10..22,B12..17,NN",
                      marks=pytest.mark.slow),
     ])

@@ -104,7 +104,7 @@ def test_chrome_trace_and_jsonl_exports(tmp_path):
 # -- pillar 2: compile events + registry --------------------------------------
 
 
-def test_tracked_call_records_compile_once():
+def test_tracked_call_records_compile_once(cold_compile_cache):
     import jax.numpy as jnp
 
     from gameoflifewithactors_tpu.ops._jit import optionally_donated
@@ -136,7 +136,7 @@ def test_tracked_call_records_compile_once():
         sum(e.wall_seconds for e in log.events()))
 
 
-def test_engine_step_emits_compile_event():
+def test_engine_step_emits_compile_event(cold_compile_cache):
     """The jit entry points in ops/_jit.py are the choke point: stepping a
     fresh (shape, rule) through the engine must leave a CompileEvent in
     the global log, naming the runner."""
@@ -327,7 +327,7 @@ def test_report_cli_subcommand(tmp_path, capsys):
 # -- the StepMetrics compile-exclusion regression -----------------------------
 
 
-def test_step_metrics_exclude_compile_time():
+def test_step_metrics_exclude_compile_time(cold_compile_cache):
     """ISSUE-1 regression: the compile a tick pays is reported in
     ``compile_seconds``, never inside ``wall_seconds`` — so post-warmup
     rates and first-tick rates describe the same quantity (stepping)."""

@@ -478,3 +478,32 @@ def test_tpu_generation_env_override(monkeypatch):
     monkeypatch.setenv("GOLTPU_TPU_GENERATION", "latest")
     with pytest.raises(ValueError, match="GOLTPU_TPU_GENERATION"):
         ps._ltl_vmem_limit()
+
+
+def test_pre_v4_model_safety_factor(monkeypatch):
+    """ADVICE r5 #2: the count-plane term of the LtL VMEM model is
+    calibrated from ONE Mosaic measurement (r=5 box, g=8, bh=512,
+    Wp=256); on pre-v4 cores the 14-vs-16 MiB budget gap absorbs only
+    ~2 MiB of extrapolation error, so the model is inflated by
+    _LTL_MODEL_SAFETY_PRE_V4 there — and ONLY there (v4+ keeps the
+    uninflated model: its 48-vs-64 MiB slack already exceeds the
+    factor)."""
+    from gameoflifewithactors_tpu.ops import pallas_stencil as ps
+
+    r, bh, g, Wp = 3, 256, 8, 128
+    hr = r * g
+    base = ps._ltl_vmem_bytes(bh, hr, Wp, r=r)
+    monkeypatch.setenv("GOLTPU_TPU_GENERATION", "v5e")
+    assert ps._ltl_vmem_model(r)(bh, hr, Wp) == base
+    monkeypatch.setenv("GOLTPU_TPU_GENERATION", "3")
+    inflated = ps._ltl_vmem_model(r)(bh, hr, Wp)
+    assert inflated == int(base * ps._LTL_MODEL_SAFETY_PRE_V4) > base
+    # the factor actually bites: a shape the raw model would admit at
+    # the pre-v4 budget is rejected once inflated (block picking then
+    # chooses a shorter block instead of flying 2 MiB from the ceiling)
+    budget = ps._VMEM_BUDGET
+    bh_edge = next(b for b in range(1024, 8, -8)
+                   if ps._ltl_vmem_bytes(b, hr, 256, r=r) <= budget
+                   and int(ps._ltl_vmem_bytes(b, hr, 256, r=r)
+                           * ps._LTL_MODEL_SAFETY_PRE_V4) > budget)
+    assert ps._ltl_vmem_model(r)(bh_edge, hr, 256) > budget
