@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -98,6 +99,16 @@ def _config_key(args) -> str:
     return f"{args.backend}:{args.size or 'default'}:{args.rule}"
 
 
+def _default_report_path(key: str) -> str:
+    """Where a measurement's RunReport lands when the caller didn't pick:
+    next to the persisted BENCH record, named by the config key — so the
+    perf gate and later audits have per-measurement provenance (phase
+    breakdown, compile attribution, stalls), not just the headline."""
+    safe = re.sub(r"[^A-Za-z0-9._-]+", "-", key)
+    return os.path.join(os.path.dirname(PERSIST_PATH),
+                        f"bench_report_{safe}.json")
+
+
 def _load_persisted(key: str) -> dict | None:
     try:
         with open(PERSIST_PATH) as f:
@@ -125,7 +136,8 @@ def _load_persisted(key: str) -> dict | None:
     return hit
 
 
-def _persist_if_best(key: str, result: dict) -> None:
+def _persist_if_best(key: str, result: dict,
+                     report_path: str | None = None) -> None:
     try:
         with open(PERSIST_PATH) as f:
             store = json.load(f)
@@ -154,6 +166,11 @@ def _persist_if_best(key: str, result: dict) -> None:
         # measurements, which staleness() refuses to certify as fresh)
         store[key] = {**result, "ok": True, **stamp,
                       "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        if report_path and os.path.exists(report_path):
+            # pointer to the measurement's RunReport (repo-relative so a
+            # fresh checkout resolves it)
+            store[key]["telemetry_report"] = os.path.relpath(
+                report_path, os.path.dirname(os.path.dirname(PERSIST_PATH)))
         os.makedirs(os.path.dirname(PERSIST_PATH), exist_ok=True)
         tmp = PERSIST_PATH + ".tmp"
         with open(tmp, "w") as f:
@@ -543,7 +560,23 @@ def main() -> None:
     repo = os.path.dirname(os.path.abspath(__file__))
     key = _config_key(args)
     child_argv = [a for a in sys.argv[1:] if a != "--no-probe"]
+    # every measuring child writes a RunReport next to the BENCH record
+    # it may persist (per-measurement provenance for the perf gate); an
+    # explicit --telemetry-out still wins
+    report_defaulted = args.telemetry_out is None
+    report_path = args.telemetry_out
+    if report_defaulted:
+        report_path = _default_report_path(key)
+        child_argv += ["--telemetry-out", report_path]
     cmd = [sys.executable, os.path.abspath(__file__), "--child", *child_argv]
+
+    def _quarantine_cpu_report() -> None:
+        # a CPU-platform measurement must not overwrite the TPU report
+        # the persisted record's telemetry_report pointer names — park it
+        # under a .cpu suffix instead (only for the defaulted path; an
+        # explicit --telemetry-out is the caller's own business)
+        if report_defaulted and os.path.exists(report_path):
+            os.replace(report_path, report_path[:-5] + ".cpu.json")
 
     tpu_ok = True
     if not args.no_probe:
@@ -572,7 +605,9 @@ def main() -> None:
                         continue
                 if result is not None:
                     if "cpu" not in result["metric"]:
-                        _persist_if_best(key, result)
+                        _persist_if_best(key, result, report_path)
+                    else:
+                        _quarantine_cpu_report()
                     print(line)
                     return
                 sys.stderr.write("\nbench child printed no JSON measurement; falling back\n")
@@ -633,6 +668,7 @@ def main() -> None:
         raise SystemExit(1)
     sys.stdout.write(r.stdout)
     sys.stderr.write(r.stderr)
+    _quarantine_cpu_report()  # the fallback child is CPU by construction
     if r.returncode != 0:
         raise SystemExit(r.returncode)
 

@@ -39,7 +39,10 @@ def _run_elementary(cfg, args, rule) -> int:
                         ("--metrics", cfg.metrics), ("--mesh", cfg.mesh),
                         ("--ppm-every", cfg.ppm_every or None),
                         ("--save-rle", cfg.save_rle),
-                        ("--telemetry-out", cfg.telemetry_out)):
+                        ("--telemetry-out", cfg.telemetry_out),
+                        ("--serve-metrics", cfg.serve_metrics),
+                        ("--flight-dump", cfg.flight_dump),
+                        ("--device-poll", cfg.device_poll)):
         if value is not None:
             raise SystemExit(
                 f"{flag} is not supported for 1D W-rules (the spacetime "
@@ -119,12 +122,36 @@ def _report_cmd(argv: Sequence[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="gameoflifewithactors_tpu report",
         description="summarize a RunReport JSON (--telemetry-out artifact)")
-    ap.add_argument("path", help="RunReport JSON file")
+    ap.add_argument("path", help="RunReport JSON file (the baseline in "
+                                 "--diff mode)")
     ap.add_argument("--json", action="store_true",
                     help="re-emit the raw JSON (validated) instead")
+    ap.add_argument("--diff", default=None, metavar="OTHER.json",
+                    help="instead of a summary, print the per-phase / "
+                         "per-metric delta table PATH -> OTHER (thin "
+                         "wrapper over obs.diff; OTHER is the newer run)")
     args = ap.parse_args(argv)
     from .obs.report import RunReport
 
+    if args.diff:
+        # raw-JSON loads: either side may be a bench record, not a
+        # RunReport — the differ speaks both shapes
+        import json as json_lib
+
+        from .obs import diff as diff_lib
+
+        with open(args.path) as f:
+            base = json_lib.load(f)
+        with open(args.diff) as f:
+            other = json_lib.load(f)
+        rows = diff_lib.diff_records(base, other)
+        if args.json:
+            print(json_lib.dumps([r.to_dict() for r in rows], indent=1))
+        else:
+            print(f"delta {args.path} -> {args.diff} "
+                  "(ratio = other / baseline):")
+            print("\n".join(diff_lib.format_rows(rows)))
+        return 0
     rep = RunReport.load(args.path)
     if args.json:
         print(rep.to_json())
@@ -221,6 +248,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     coordinator, scheduler = cfg.build()
 
+    # continuous telemetry: live Prometheus exposition + device sampler.
+    # Started BEFORE the run loop (the whole point is scraping while
+    # stepping); daemon threads, explicitly stopped at the end.
+    import os
+
+    exporter = sampler = None
+    serve_port = cfg.serve_metrics
+    if serve_port is None and os.environ.get("GOLTPU_METRICS_PORT"):
+        serve_port = int(os.environ["GOLTPU_METRICS_PORT"])
+    if serve_port is not None:
+        from .obs.device import DeviceSampler
+        from .obs.exporter import serve_metrics
+
+        exporter = serve_metrics(serve_port)
+        sampler = DeviceSampler(cfg.device_poll).start()
+        print(f"serving metrics: http://0.0.0.0:{exporter.port}/metrics",
+              file=sys.stderr)
+
+    # flight recorder: armed for any telemetry run (default path rides
+    # next to the RunReport), or standalone via --flight-dump
+    flight_path = cfg.flight_dump or (
+        cfg.telemetry_out + ".flight.jsonl" if cfg.telemetry_out else None)
     telem = None
     if cfg.telemetry_out:
         from .obs import begin_run_telemetry
@@ -229,8 +278,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # resume) would be attributed to no tick, but the watchdog must
         # not watch interactive seed parsing either — run time only
         telem = begin_run_telemetry(
-            stall_deadline=cfg.stall_deadline or 60.0)
+            stall_deadline=cfg.stall_deadline or 60.0,
+            flight_path=flight_path)
         telem.attach(coordinator)
+    elif flight_path:
+        from .obs import flight as flight_lib
+
+        fr = flight_lib.arm(flight_lib.FlightRecorder(flight_path))
+        if coordinator.metrics is not None:
+            # tape before user-facing sinks (see RunTelemetry.attach)
+            coordinator.metrics.sinks.insert(0, fr.on_step)
 
     if args.render == "live":
         coordinator.subscribe(ConsoleRenderer())
@@ -308,6 +365,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.save(cfg.telemetry_out)
         print(f"telemetry report written: {cfg.telemetry_out}",
               file=sys.stderr)
+    elif flight_path:
+        from .obs import flight as flight_lib
+
+        flight_lib.disarm()  # clean exit: no crash report to leave
+
+    if sampler is not None:
+        sampler.stop()
+    if exporter is not None:
+        exporter.stop()
 
     coordinator.engine.block_until_ready()
     return 0

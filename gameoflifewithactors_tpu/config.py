@@ -48,6 +48,10 @@ class SimulationConfig:
     save_rle: Optional[str] = None          # final state as RLE (binary rules)
     telemetry_out: Optional[str] = None     # RunReport JSON path (obs/)
     stall_deadline: Optional[float] = None  # watchdog deadline seconds
+    serve_metrics: Optional[int] = None     # Prometheus /metrics port (obs/)
+    flight_dump: Optional[str] = None       # flight-recorder dump path;
+    #                                         default <telemetry_out>.flight.jsonl
+    device_poll: Optional[float] = None     # device-sampler interval seconds
     cache_dir: Optional[str] = None         # warm-start cache root (aot/);
     #                                         None = GOLTPU_CACHE_DIR env or
     #                                         ~/.cache/gameoflifewithactors_tpu
@@ -236,6 +240,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "Default: $GOLTPU_CACHE_DIR, else "
                         "~/.cache/gameoflifewithactors_tpu; pass '' to "
                         "disable caching for this run")
+    p.add_argument("--serve-metrics", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus text-format metrics (registry "
+                        "counters + live HBM gauges) at "
+                        "http://0.0.0.0:PORT/metrics while the run steps; "
+                        "0 picks an ephemeral port (printed to stderr). "
+                        "Also honored via $GOLTPU_METRICS_PORT")
+    p.add_argument("--flight-dump", default=None, metavar="PATH",
+                   help="flight-recorder crash-report path (JSONL): the "
+                        "last N StepMetrics/spans/compile events + a "
+                        "registry snapshot, written on watchdog stall, "
+                        "coordinator exception, or SIGTERM/SIGINT. "
+                        "Default with --telemetry-out: "
+                        "<telemetry-out>.flight.jsonl")
+    p.add_argument("--device-poll", type=float, default=None, metavar="S",
+                   help="device memory sampler interval in seconds "
+                        "(default 1.0, or $GOLTPU_DEVICE_POLL_S); feeds "
+                        "the hbm_bytes_* gauges --serve-metrics exposes")
     p.add_argument("--stall-deadline", type=float, default=None, metavar="S",
                    help="with --telemetry-out: flag any tick exceeding S "
                         "seconds, naming the last-completed span "
@@ -280,6 +301,9 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         save_rle=args.save_rle,
         telemetry_out=args.telemetry_out,
         stall_deadline=args.stall_deadline,
+        serve_metrics=args.serve_metrics,
+        flight_dump=args.flight_dump,
+        device_poll=args.device_poll,
         cache_dir=args.cache_dir,
     )
     return cfg, args

@@ -33,6 +33,7 @@ from .engine import Engine
 from .models import seeds as seeds_lib
 from .models.rules import Rule, parse_rule
 from .obs import compile as obs_compile
+from .obs import flight as obs_flight
 from .obs import spans as obs_spans
 from .obs import watchdog as obs_watchdog
 from .ops.stencil import Topology
@@ -151,13 +152,23 @@ class GridCoordinator:
 
         When a stall watchdog is armed (obs.watchdog.arm), the whole tick
         runs under its watch so a wedged dispatch/sync is flagged — with
-        the last-completed span named — while still stuck."""
+        the last-completed span named — while still stuck. When a flight
+        recorder is armed (obs.flight.arm), an exception escaping the
+        tick leaves a crash dump before propagating — the post-mortem a
+        dead coordinator loop otherwise has none of."""
         wd = obs_watchdog.active_watchdog()
-        if wd is not None:
-            with wd.watch(f"tick@gen{self.generation}+{n}"):
+        try:
+            if wd is not None:
+                with wd.watch(f"tick@gen{self.generation}+{n}"):
+                    self._tick(n)
+            else:
                 self._tick(n)
-        else:
-            self._tick(n)
+        except BaseException as exc:
+            fr = obs_flight.active_flight_recorder()
+            if fr is not None:
+                fr.dump("exception in coordinator loop: "
+                        f"{type(exc).__name__}: {exc}")
+            raise
 
     def _tick(self, n: int) -> None:
         t0 = time.perf_counter()

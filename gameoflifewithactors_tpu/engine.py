@@ -910,6 +910,48 @@ class Engine:
                       + col_sends * dx * (fy // nx + 2 * dy) * 4)
         return total
 
+    def runner_cost_analysis(self, gens: int = 8) -> Optional[dict]:
+        """XLA's static cost analysis of THIS engine's compiled runner —
+        the FLOPs and HBM bytes one ``gens``-generation dispatch costs,
+        straight from ``Compiled.cost_analysis()`` (no arithmetic model,
+        no hand-maintained constants). Feeds the RunReport's roofline
+        section (obs/device.py). One extra lowering+compile the first
+        time (served by the persistent cache on repeats), cached for the
+        engine's lifetime; None for the sparse backend (its on-device
+        while-loop cost depends on activity, a static figure would lie)
+        and on platforms whose compiler refuses the query.
+        """
+        if self._sparse is not None:
+            return None
+        cache = getattr(self, "_cost_analysis_cache", None)
+        if cache is None:
+            cache = self._cost_analysis_cache = {}
+        if gens in cache:
+            return cache[gens]
+        result = None
+        try:
+            with warnings.catch_warnings():
+                # inner runners donate their args; under this outer
+                # non-donating jit that degrades to a (correct) copy and
+                # a donation warning we don't want surfaced per report
+                warnings.simplefilter("ignore")
+                compiled = jax.jit(
+                    lambda s: self._run(s, gens)).lower(self.state).compile()
+                ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                result = {
+                    "generations": gens,
+                    "flops": float(ca["flops"]) if ca.get("flops") else None,
+                    "bytes_accessed": (float(ca["bytes accessed"])
+                                       if ca.get("bytes accessed") else None),
+                }
+        except Exception:
+            result = None
+        cache[gens] = result
+        return result
+
     def active_tiles(self) -> Optional[int]:
         """Active-tile count of a sparse engine — the compute actually
         paid per generation, the observability number that explains why a

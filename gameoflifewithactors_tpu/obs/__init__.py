@@ -25,6 +25,22 @@ Three pillars:
   ``perfetto_summary`` duty cycle into one JSON artifact — wired into
   ``bench.py`` and the CLI (``--telemetry-out``, ``report`` subcommand).
 
+ISSUE-3 adds the *continuous* layer on top — what is the device doing
+right now, and did the last change regress us:
+
+- **Device sampler + roofline** (:mod:`.device`): a background poller
+  folding ``memory_stats()`` into registry gauges, and XLA
+  cost-analysis-based roofline attribution in the RunReport.
+- **Prometheus exposition** (:mod:`.exporter`): the registry served as
+  scrape-able text over a stdlib HTTP thread (CLI ``--serve-metrics``).
+- **Flight recorder** (:mod:`.flight`): ring buffers of the last N
+  StepMetrics / spans / compile events, dumped as a JSONL crash report
+  on watchdog stall, coordinator-loop exception, or SIGTERM/SIGINT.
+- **Report differ** (:mod:`.diff`): per-metric tolerance-banded deltas
+  between two RunReports/bench records — the comparator under
+  ``scripts/perf_gate.py`` and ``report --diff``, honoring the PR-2
+  staleness flags (a stale record gates as "skipped", never "ok").
+
 No module in this package imports jax at module scope (device/engine
 lookups are lazy, inside the calls that need them), mirroring how
 bench.py loads utils/provenance.py standalone: recorders and report
@@ -48,6 +64,10 @@ from .compile import (  # noqa: F401
 )
 from .watchdog import StallEvent, StallWatchdog, active_watchdog, arm, disarm  # noqa: F401
 from .report import RunReport, RunTelemetry, begin_run_telemetry  # noqa: F401
+from .device import DeviceSampler, roofline_section  # noqa: F401
+from .exporter import MetricsServer, render_prometheus, serve_metrics  # noqa: F401
+from .flight import FlightRecorder, active_flight_recorder, load_dump  # noqa: F401
+from .diff import diff_records, format_rows, gate  # noqa: F401
 
 __all__ = [
     "Span", "SpanTracer", "TRACER", "span",
@@ -55,4 +75,8 @@ __all__ = [
     "CompileEvent", "CompileEventLog", "COMPILE_LOG", "tracked_call",
     "StallEvent", "StallWatchdog", "active_watchdog", "arm", "disarm",
     "RunReport", "RunTelemetry", "begin_run_telemetry",
+    "DeviceSampler", "roofline_section",
+    "MetricsServer", "render_prometheus", "serve_metrics",
+    "FlightRecorder", "active_flight_recorder", "load_dump",
+    "diff_records", "format_rows", "gate",
 ]

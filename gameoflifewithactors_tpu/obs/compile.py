@@ -74,10 +74,28 @@ class CompileEventLog:
     def __init__(self, maxlen: int = MAX_EVENTS):
         self._events: Deque[CompileEvent] = collections.deque(maxlen=maxlen)
         self._lock = threading.Lock()
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Call ``fn(event)`` on every recorded event (flight recorder
+        tap); runs on the recording thread, outside the log lock."""
+        with self._lock:
+            self._listeners = self._listeners + [fn]
+
+    def remove_listener(self, fn) -> None:
+        # equality, not identity: bound methods are rebuilt per access
+        with self._lock:
+            self._listeners = [f for f in self._listeners if f != fn]
 
     def record(self, ev: CompileEvent) -> None:
         with self._lock:
             self._events.append(ev)
+            listeners = self._listeners
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:
+                pass  # a broken tap must not break the compiling call
 
     def events(self) -> List[CompileEvent]:
         with self._lock:

@@ -1,0 +1,304 @@
+"""Continuous device-resource sampling + roofline attribution.
+
+PR 1's telemetry is post-hoc (spans and compile events folded into a
+RunReport after the run ends); this module answers "what is the device
+doing *right now*" and "how close is this runner to the roofline":
+
+- :class:`DeviceSampler` — a daemon thread polling every local device's
+  ``memory_stats()`` (bytes_in_use / peak / limit) into registry gauges
+  (``hbm_bytes_in_use`` etc.) on a configurable interval, so a scraped
+  ``/metrics`` endpoint (obs/exporter.py) shows live HBM pressure while
+  the engine steps. Backends without ``memory_stats`` (CPU) fall back to
+  host-process RSS, labeled ``source="host_rss"`` so the number is never
+  mistaken for device memory.
+- :func:`roofline_section` — per-runner static cost attribution: XLA's
+  own cost analysis of the *compiled* runner (``Compiled.cost_analysis``:
+  FLOPs, bytes accessed — see ``Engine.runner_cost_analysis``) folded
+  with the measured ``StepMetrics`` wall time into achieved-vs-modelled
+  throughput. The arithmetic peak model (the figures BASELINE.md and
+  ``scripts/roofline_report.py`` quote) lives here as :data:`PEAKS` so
+  every consumer reads one source.
+
+Like the rest of ``obs/``, no jax import at module scope: the sampler
+looks devices up lazily inside the thread, and a wedged backend degrades
+to the host fallback instead of taking the telemetry layer down.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+from .registry import REGISTRY, MetricsRegistry
+
+DEFAULT_INTERVAL_S = 1.0
+ENV_POLL = "GOLTPU_DEVICE_POLL_S"
+
+# Arithmetic peak model per platform — promoted from
+# scripts/roofline_report.py ARITHMETIC so the RunReport and the script
+# quote the same bounds. hbm_gbps is the memory-bandwidth roof the
+# stencil family actually runs against (the packed kernels are
+# HBM-traffic engineered, BASELINE.md "Roofline sanity bound");
+# cell_updates_ceiling is the 2-HBM-touch packed model at g=8 temporal
+# blocking. CPU has no published bound on this rig — consumers get None
+# and must say "unmodelled", never invent a denominator.
+PEAKS = {
+    "tpu": {
+        "hbm_gbps": 820.0,                 # v5e HBM bandwidth
+        "packed_2touch_ceiling": 3.3e12,   # 2 HBM touches/gen, 32 cells/word
+        "temporal_g8_ceiling": 2.6e13,     # 2 touches per 8 gens
+    },
+}
+
+
+def _host_rss_stats() -> dict:
+    """Host-process RSS as the CPU stand-in for device memory stats.
+    /proc on Linux (current RSS), ru_maxrss everywhere (peak)."""
+    stats: dict = {"source": "host_rss"}
+    try:
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        stats["peak_bytes_in_use"] = int(peak_kb) * 1024
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        stats["bytes_in_use"] = rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        # no /proc: serve peak as the (monotone) in-use figure rather
+        # than nothing — a gauge that exists beats a gauge that lies low
+        if "peak_bytes_in_use" in stats:
+            stats["bytes_in_use"] = stats["peak_bytes_in_use"]
+    return stats
+
+
+def default_memory_backend() -> List[dict]:
+    """One dict per local device: {device, platform, bytes_in_use, ...}.
+    The injectable seam the sampler polls — tests swap in a fake."""
+    import jax
+
+    out = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        rec = {"device": str(dev.id), "platform": dev.platform,
+               "source": "memory_stats"}
+        if stats:
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "bytes_reserved", "largest_free_block_bytes"):
+                if stats.get(k) is not None:
+                    rec[k] = int(stats[k])
+        else:  # CPU / backends without allocator stats
+            rec.update(_host_rss_stats())
+        out.append(rec)
+    return out
+
+
+class DeviceSampler:
+    """Background poller: device memory stats -> registry gauges.
+
+    ``with DeviceSampler(0.5): ...`` or ``start()``/``stop()``. Each
+    sample sets ``hbm_bytes_in_use`` / ``hbm_bytes_peak`` /
+    ``hbm_bytes_limit`` gauges labeled by device id + platform (+
+    ``source`` when the figure is the host-RSS fallback) and bumps the
+    ``device_samples`` counter — everything lands in the same registry
+    the Prometheus exporter and the RunReport snapshot read.
+    ``sample_once()`` is the deterministic unit tests drive."""
+
+    _GAUGES = {"bytes_in_use": ("hbm_bytes_in_use",
+                                "device memory currently allocated (bytes)"),
+               "peak_bytes_in_use": ("hbm_bytes_peak",
+                                     "high-water device allocation (bytes)"),
+               "bytes_limit": ("hbm_bytes_limit",
+                               "device memory capacity (bytes)")}
+
+    def __init__(self, interval_seconds: Optional[float] = None, *,
+                 registry: MetricsRegistry = REGISTRY,
+                 backend: Optional[Callable[[], List[dict]]] = None):
+        if interval_seconds is None:
+            interval_seconds = float(
+                os.environ.get(ENV_POLL, DEFAULT_INTERVAL_S))
+        if interval_seconds <= 0:
+            raise ValueError(
+                f"poll interval must be positive, got {interval_seconds}")
+        self.interval = float(interval_seconds)
+        self.registry = registry
+        self._backend = backend or default_memory_backend
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples = 0
+
+    def sample_once(self) -> List[dict]:
+        """One poll; returns what the backend reported (tests assert on
+        it). Never raises — a wedged backend yields an empty sample and
+        a bumped ``device_sample_errors`` counter instead."""
+        try:
+            stats = self._backend()
+        except Exception as exc:
+            self.registry.counter(
+                "device_sample_errors",
+                "device memory polls that raised").inc(
+                    error=type(exc).__name__)
+            return []
+        for rec in stats:
+            labels = {"device": str(rec.get("device", "?")),
+                      "platform": str(rec.get("platform", "?"))}
+            if rec.get("source") == "host_rss":
+                labels["source"] = "host_rss"
+            for key, (gname, ghelp) in self._GAUGES.items():
+                if rec.get(key) is not None:
+                    self.registry.gauge(gname, ghelp).set(
+                        float(rec[key]), **labels)
+        self.samples += 1
+        self.registry.counter(
+            "device_samples", "device memory polls completed").inc()
+        return stats
+
+    # -- the poller thread ---------------------------------------------------
+
+    def start(self) -> "DeviceSampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._poll, name="device-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _poll(self) -> None:
+        # sample immediately (a short run should still leave gauges),
+        # then on the interval until stopped
+        self.sample_once()
+        while not self._stop.wait(self.interval):
+            self.sample_once()
+
+    def __enter__(self) -> "DeviceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# -- roofline attribution -----------------------------------------------------
+
+
+def roofline_section(*, cost: Optional[dict] = None,
+                     step_records: Optional[list] = None,
+                     platform: Optional[str] = None,
+                     gens: Optional[int] = None) -> Optional[dict]:
+    """Fold static XLA cost analysis with measured step rates.
+
+    ``cost`` is ``Engine.runner_cost_analysis()`` output (``flops`` /
+    ``bytes_accessed`` for a ``gens``-generation dispatch of the compiled
+    runner); ``step_records`` are StepMetrics dicts or objects. Returns
+    the RunReport ``roofline`` dict — static per-generation cost,
+    achieved throughput (best measured record), and achieved-vs-modelled
+    fractions against :data:`PEAKS` — or None when there is nothing to
+    attribute (no cost analysis and no measurements).
+    """
+    gens = gens or (cost or {}).get("generations") or 1
+    section: dict = {}
+    if cost:
+        flops = cost.get("flops")
+        bytes_acc = cost.get("bytes_accessed")
+        section["cost_analysis"] = {
+            "generations": gens,
+            "flops": flops,
+            "bytes_accessed": bytes_acc,
+            "flops_per_gen": flops / gens if flops else None,
+            "bytes_per_gen": bytes_acc / gens if bytes_acc else None,
+        }
+        if flops and bytes_acc:
+            section["cost_analysis"]["arithmetic_intensity"] = \
+                flops / bytes_acc
+
+    best = None
+    records = [m if isinstance(m, dict) else m.to_dict()
+               for m in step_records or []]
+    rated = [m for m in records if m.get("cell_updates_per_sec")]
+    if rated:
+        best = max(rated, key=lambda m: m["cell_updates_per_sec"])
+        rate = best["cell_updates_per_sec"]
+        section["achieved"] = {
+            "cell_updates_per_sec": rate,
+            "records": len(rated),
+        }
+        ca = section.get("cost_analysis") or {}
+        cells_per_gen = None
+        if best.get("generations_stepped") and best.get("wall_seconds"):
+            cells_per_gen = (rate * best["wall_seconds"]
+                             / best["generations_stepped"])
+        if ca.get("flops_per_gen") and cells_per_gen:
+            # measured rate x static per-cell cost = achieved FLOP/s and
+            # HBM traffic of the runner XLA actually compiled
+            section["achieved"]["flops_per_sec"] = \
+                rate * ca["flops_per_gen"] / cells_per_gen
+            if ca.get("bytes_per_gen"):
+                section["achieved"]["bytes_per_sec"] = \
+                    rate * ca["bytes_per_gen"] / cells_per_gen
+
+    if not section:
+        return None
+
+    peaks = PEAKS.get(platform or "")
+    section["platform"] = platform
+    if peaks:
+        section["peak_modelled"] = dict(peaks)
+        if best is not None:
+            frac = {}
+            rate = best["cell_updates_per_sec"]
+            if peaks.get("temporal_g8_ceiling"):
+                frac["of_temporal_g8_ceiling"] = \
+                    rate / peaks["temporal_g8_ceiling"]
+            bps = section.get("achieved", {}).get("bytes_per_sec")
+            if bps and peaks.get("hbm_gbps"):
+                frac["of_hbm_bandwidth"] = bps / (peaks["hbm_gbps"] * 1e9)
+            if frac:
+                section["achieved_fraction"] = frac
+    else:
+        # no invented denominators: an unmodelled platform says so
+        section["peak_modelled"] = None
+    return section
+
+
+def summary_lines(roofline: dict) -> List[str]:
+    """The human face of a roofline section (RunReport.summary_lines)."""
+    lines = []
+    ca = roofline.get("cost_analysis") or {}
+    if ca.get("flops_per_gen"):
+        per = f"  {ca['flops_per_gen']:.3g} FLOPs/gen"
+        if ca.get("bytes_per_gen"):
+            per += f", {ca['bytes_per_gen']:.3g} HBM bytes/gen"
+        if ca.get("arithmetic_intensity"):
+            per += f" (intensity {ca['arithmetic_intensity']:.2f})"
+        lines.append("roofline (XLA cost analysis of the compiled runner):")
+        lines.append(per)
+    ach = roofline.get("achieved") or {}
+    if ach.get("cell_updates_per_sec"):
+        line = f"  achieved {ach['cell_updates_per_sec']:.3g} cell-updates/s"
+        if ach.get("flops_per_sec"):
+            line += f" = {ach['flops_per_sec']:.3g} FLOP/s"
+        if ach.get("bytes_per_sec"):
+            line += f", {ach['bytes_per_sec'] / 1e9:.1f} GB/s HBM"
+        lines.append(line)
+    frac = roofline.get("achieved_fraction") or {}
+    if frac.get("of_hbm_bandwidth") is not None:
+        lines.append(
+            f"  {frac['of_hbm_bandwidth']:.1%} of the "
+            f"{roofline['peak_modelled']['hbm_gbps']:.0f} GB/s modelled "
+            "HBM bound")
+    elif roofline.get("peak_modelled") is None and lines:
+        lines.append(f"  (no modelled peak for platform "
+                     f"{roofline.get('platform')!r})")
+    return lines

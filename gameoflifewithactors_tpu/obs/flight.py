@@ -1,0 +1,244 @@
+"""Flight recorder: a bounded black box that dumps on trouble.
+
+The wedged-probe failure mode (results/watch_r05.log) is a run that dies
+with *zero* artifacts: the watchdog's stderr line is the only witness,
+and a SIGTERM from a subprocess harness leaves nothing at all. The
+flight recorder keeps ring buffers of the last N ``StepMetrics``, span
+completions, and compile events (tapped live off the process recorders —
+a later ``TRACER.clear()`` cannot erase what was already taped), and on
+
+- a watchdog stall (chained via ``StallWatchdog.add_on_stall``),
+- an unhandled exception escaping the coordinator tick loop, or
+- SIGTERM / SIGINT
+
+writes one JSONL crash report: a header line naming the trigger and the
+last completed span, then the taped records, then a full metrics-registry
+snapshot. The dump path is pre-opened-directory cheap (one atomic
+``os.replace``), and dumping is idempotent per trigger but repeatable —
+a stall dump followed by the SIGTERM dump overwrites with strictly more
+recent tape.
+
+Like the watchdog, a process-default recorder can be armed
+(:func:`arm`) so ``GridCoordinator.tick`` finds it without plumbing.
+Stdlib only; must stay importable and dumpable while jax is wedged.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Deque, List, Optional
+
+from . import compile as compile_lib
+from . import spans as spans_lib
+from .registry import REGISTRY
+
+DEFAULT_MAX_RECORDS = 256
+SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Tape the last N telemetry records; dump a crash report on demand."""
+
+    def __init__(self, path: str, *, max_records: int = DEFAULT_MAX_RECORDS,
+                 registry=REGISTRY,
+                 tracer: Optional[spans_lib.SpanTracer] = None,
+                 compile_log: Optional[compile_lib.CompileEventLog] = None):
+        self.path = path
+        self.registry = registry
+        self._tracer = tracer or spans_lib.TRACER
+        self._compile_log = compile_log or compile_lib.COMPILE_LOG
+        self._steps: Deque[dict] = collections.deque(maxlen=max_records)
+        self._spans: Deque[dict] = collections.deque(maxlen=max_records)
+        self._compiles: Deque[dict] = collections.deque(maxlen=max_records)
+        self._stalls: List[dict] = []
+        self._lock = threading.Lock()
+        self._installed = False
+        self._watchdog = None
+        self._prev_handlers: dict = {}
+        self.dumps = 0
+        self.last_dump_reason: Optional[str] = None
+
+    # -- the tape (each is safe from any thread) -----------------------------
+
+    def on_step(self, m) -> None:
+        """StepMetrics sink — hang on a MetricsLogger next to the
+        RunTelemetry buffer."""
+        with self._lock:
+            self._steps.append(m if isinstance(m, dict) else m.to_dict())
+
+    def on_span(self, s) -> None:
+        with self._lock:
+            self._spans.append(s if isinstance(s, dict) else s.to_dict())
+
+    def on_compile(self, ev) -> None:
+        with self._lock:
+            self._compiles.append(
+                ev if isinstance(ev, dict) else ev.to_dict())
+
+    def on_stall(self, ev) -> None:
+        with self._lock:
+            self._stalls.append(ev if isinstance(ev, dict) else ev.to_dict())
+        self.dump(f"watchdog stall: {getattr(ev, 'label', '?')}")
+
+    # -- wiring --------------------------------------------------------------
+
+    def install(self, *, watchdog=None, signals: bool = True) -> "FlightRecorder":
+        """Tap the process recorders; optionally chain onto a watchdog's
+        stall sink and take over SIGTERM/SIGINT (dump, then hand the
+        signal on to whatever handler was there — default die included).
+        Signal handlers only install from the main thread; elsewhere the
+        tape still runs, just without the signal trigger."""
+        if self._installed:
+            return self
+        self._installed = True
+        self._tracer.add_listener(self.on_span)
+        self._compile_log.add_listener(self.on_compile)
+        if watchdog is not None:
+            self._watchdog = watchdog
+            watchdog.add_on_stall(self.on_stall)
+        if signals:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._prev_handlers[sig] = signal.getsignal(sig)
+                    signal.signal(sig, self._on_signal)
+                except (ValueError, OSError):  # not the main thread
+                    self._prev_handlers.pop(sig, None)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        self._tracer.remove_listener(self.on_span)
+        self._compile_log.remove_listener(self.on_compile)
+        if self._watchdog is not None:
+            self._watchdog.remove_on_stall(self.on_stall)
+            self._watchdog = None
+        for sig, prev in self._prev_handlers.items():
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self.dump(f"signal {name}")
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # restore the default and re-raise so the process still dies
+            # with the right signal disposition (a harness watching the
+            # exit status must see SIGTERM, not a clean exit)
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+        # SIG_IGN / None: dump taken, signal swallowed as before
+
+    # -- the crash report ----------------------------------------------------
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> str:
+        """Write the JSONL crash report (atomic replace). Returns the
+        path. Never raises — a dump failure at crash time must not mask
+        the crash itself; it falls back to a stderr line."""
+        with self._lock:
+            steps = list(self._steps)
+            spans = list(self._spans)
+            compiles = list(self._compiles)
+            stalls = list(self._stalls)
+        last = self._tracer.last_completed()
+        header = {
+            "type": "flight",
+            "schema_version": SCHEMA_VERSION,
+            "reason": reason,
+            "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "pid": os.getpid(),
+            "last_completed_span": last.name if last else None,
+            "open_spans": self._tracer.current_stack(),
+            "counts": {"step_metrics": len(steps), "spans": len(spans),
+                       "compile_events": len(compiles),
+                       "stalls": len(stalls)},
+        }
+        if extra:
+            header.update(extra)
+        try:
+            # per-thread tmp name: a signal-handler dump racing the
+            # watchdog thread's dump must not interleave one tmp file
+            tmp = f"{self.path}.tmp{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                for kind, records in (("step_metrics", steps),
+                                      ("span", spans),
+                                      ("compile_event", compiles),
+                                      ("stall", stalls)):
+                    for rec in records:
+                        f.write(json.dumps({"type": kind, **rec}) + "\n")
+                f.write(json.dumps({"type": "registry",
+                                    "snapshot": self.registry.snapshot()})
+                        + "\n")
+            os.replace(tmp, self.path)
+        except Exception as exc:
+            sys.stderr.write(
+                f"flight recorder: dump to {self.path} failed "
+                f"({type(exc).__name__}: {exc})\n")
+            return self.path
+        self.dumps += 1
+        self.last_dump_reason = reason
+        sys.stderr.write(
+            f"flight recorder: dumped ({reason}) -> {self.path}\n")
+        return self.path
+
+
+def load_dump(path: str) -> dict:
+    """Parse a dump back into {"flight": header, "step_metrics": [...],
+    "span": [...], "compile_event": [...], "stall": [...], "registry":
+    snapshot} — the reader tests and post-mortem tooling use."""
+    out: dict = {"step_metrics": [], "span": [], "compile_event": [],
+                 "stall": []}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.pop("type", None)
+            if kind == "flight":
+                out["flight"] = rec
+            elif kind == "registry":
+                out["registry"] = rec.get("snapshot", {})
+            elif kind in out:
+                out[kind].append(rec)
+    return out
+
+
+# -- process-default arming (how the coordinator finds the recorder) ----------
+
+_ACTIVE: Optional[FlightRecorder] = None
+
+
+def arm(fr: FlightRecorder) -> FlightRecorder:
+    """Make ``fr`` the process-default recorder (installed) and return it."""
+    global _ACTIVE
+    _ACTIVE = fr.install()
+    return fr
+
+
+def disarm() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.uninstall()
+    _ACTIVE = None
+
+
+def active_flight_recorder() -> Optional[FlightRecorder]:
+    return _ACTIVE

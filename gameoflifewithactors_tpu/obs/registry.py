@@ -36,7 +36,11 @@ class Counter:
             self._series[k] = self._series.get(k, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._series.get(_key(labels), 0.0)
+        # reads take the lock too: a dict resize mid-read from a writer
+        # thread is a real (if rare) RuntimeError under free-threading,
+        # and a torn read is worse — silently wrong
+        with self._lock:
+            return self._series.get(_key(labels), 0.0)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -58,7 +62,8 @@ class Gauge:
             self._series[_key(labels)] = float(value)
 
     def value(self, **labels) -> Optional[float]:
-        return self._series.get(_key(labels))
+        with self._lock:
+            return self._series.get(_key(labels))
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -43,6 +43,7 @@ class RunReport:
     stalls: List[dict]
     metrics: dict                      # registry snapshot
     perfetto: Optional[dict] = None    # device duty cycle, when a trace exists
+    roofline: Optional[dict] = None    # obs.device.roofline_section output
     schema_version: int = SCHEMA_VERSION
 
     # -- serialization -------------------------------------------------------
@@ -124,6 +125,10 @@ class RunReport:
                     f"  {s['label']}: {s['elapsed_seconds']:.1f}s "
                     f"(deadline {s['deadline_seconds']:.1f}s), last span "
                     f"{s['last_completed_span'] or '<none>'}")
+        if self.roofline:
+            from . import device as device_lib
+
+            lines.extend(device_lib.summary_lines(self.roofline))
         if self.perfetto:
             busy, span = (self.perfetto.get("device_busy_us", 0.0),
                           self.perfetto.get("device_span_us", 0.0))
@@ -158,6 +163,7 @@ def build_run_report(
     trace_path: Optional[str] = None,
     config: Optional[dict] = None,
     halo_bytes: Optional[dict] = None,
+    roofline: Optional[dict] = None,
 ) -> RunReport:
     """Assemble a RunReport from whichever pillars the run exercised.
 
@@ -195,6 +201,24 @@ def build_run_report(
     for m in step_records or []:
         records.append(m if isinstance(m, dict) else m.to_dict())
 
+    if roofline is None and engine is not None:
+        # static XLA cost of the compiled runner x measured step rates.
+        # Best-effort: cost analysis needs a lowering, and a platform
+        # that refuses it must not take the report down.
+        from . import device as device_lib
+
+        try:
+            cost = engine.runner_cost_analysis()
+        except Exception:
+            cost = None
+        platform = None
+        try:
+            platform = engine.state.devices().pop().platform  # type: ignore
+        except Exception:
+            platform = _platform_info().get("platform")
+        roofline = device_lib.roofline_section(
+            cost=cost, step_records=records, platform=platform)
+
     return RunReport(
         created_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         config=config or {},
@@ -208,13 +232,23 @@ def build_run_report(
         stalls=[e.to_dict() for e in (watchdog.events if watchdog else [])],
         metrics=REGISTRY.snapshot(),
         perfetto=perfetto,
+        roofline=roofline,
     )
 
 
 class RunTelemetry:
-    """One run's telemetry session over the process-global recorders."""
+    """One run's telemetry session over the process-global recorders.
 
-    def __init__(self, *, stall_deadline: Optional[float] = None):
+    Continuous-telemetry extensions (ISSUE 3): ``flight_path`` arms a
+    :class:`~.flight.FlightRecorder` (crash-report JSONL on stall /
+    signal / coordinator-loop exception) for the session, and
+    ``device_poll`` starts a :class:`~.device.DeviceSampler` feeding HBM
+    gauges into the registry on that interval — both torn down by
+    :meth:`finish`."""
+
+    def __init__(self, *, stall_deadline: Optional[float] = None,
+                 flight_path: Optional[str] = None,
+                 device_poll: Optional[float] = None):
         from ..utils.metrics import BufferSink
 
         spans_lib.TRACER.clear()
@@ -224,6 +258,18 @@ class RunTelemetry:
         if stall_deadline:
             self.watchdog = watchdog_lib.arm(
                 watchdog_lib.StallWatchdog(stall_deadline))
+        self.flight = None
+        if flight_path:
+            from . import flight as flight_lib
+
+            self.flight = flight_lib.FlightRecorder(flight_path)
+            self.flight.install(watchdog=self.watchdog)
+            flight_lib.arm(self.flight)
+        self.sampler = None
+        if device_poll:
+            from .device import DeviceSampler
+
+            self.sampler = DeviceSampler(device_poll).start()
 
     def attach(self, coordinator) -> None:
         """Hang the StepMetrics buffer on a coordinator (creating its
@@ -234,6 +280,11 @@ class RunTelemetry:
             coordinator.metrics = MetricsLogger(self.step_buffer)
         else:
             coordinator.metrics.add_sink(self.step_buffer)
+        if self.flight is not None:
+            # the black box tapes FIRST: a signal landing between sinks
+            # must not leave a dump whose tape is missing the record a
+            # user-facing sink already printed
+            coordinator.metrics.sinks.insert(0, self.flight.on_step)
 
     def finish(self, *, engine=None, trace_path: Optional[str] = None,
                config: Optional[dict] = None,
@@ -246,6 +297,16 @@ class RunTelemetry:
         if engine is not None:
             engine.block_until_ready()
             engine.snapshot(max_shape=(8, 8))
+        if self.sampler is not None:
+            self.sampler.sample_once()  # final gauges reflect end-of-run
+            self.sampler.stop()
+        if self.flight is not None:
+            from . import flight as flight_lib
+
+            if self.flight is flight_lib.active_flight_recorder():
+                flight_lib.disarm()
+            else:
+                self.flight.uninstall()
         if self.watchdog is not None and self.watchdog is \
                 watchdog_lib.active_watchdog():
             watchdog_lib.disarm()
@@ -255,8 +316,11 @@ class RunTelemetry:
             halo_bytes=halo_bytes)
 
 
-def begin_run_telemetry(*, stall_deadline: Optional[float] = None
+def begin_run_telemetry(*, stall_deadline: Optional[float] = None,
+                        flight_path: Optional[str] = None,
+                        device_poll: Optional[float] = None
                         ) -> RunTelemetry:
     """Start a fresh telemetry session (clears the global tracer and
     compile log — earlier runs' spans must not leak into this report)."""
-    return RunTelemetry(stall_deadline=stall_deadline)
+    return RunTelemetry(stall_deadline=stall_deadline,
+                        flight_path=flight_path, device_poll=device_poll)

@@ -61,10 +61,24 @@ class SpanTracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._last: Optional[Span] = None
+        self._listeners: List = []
         self.enabled = enabled
         # perf_counter -> wall-clock anchor, so exported timestamps can be
         # correlated with a jax.profiler trace captured in the same process
         self.epoch_anchor = time.time() - time.perf_counter()
+
+    def add_listener(self, fn) -> None:
+        """Call ``fn(span)`` on every completed span (flight recorder tap).
+        Listeners run on the recording thread, outside the tracer lock —
+        they must be cheap and must not call back into the tracer."""
+        with self._lock:
+            self._listeners = self._listeners + [fn]
+
+    def remove_listener(self, fn) -> None:
+        # equality, not identity: ``obj.method`` builds a fresh bound-
+        # method object per access, so ``is`` would never match
+        with self._lock:
+            self._listeners = [f for f in self._listeners if f != fn]
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
@@ -87,6 +101,12 @@ class SpanTracer:
             with self._lock:
                 self._spans.append(s)
                 self._last = s
+                listeners = self._listeners
+            for fn in listeners:
+                try:
+                    fn(s)
+                except Exception:
+                    pass  # a broken tap must not break the traced code
 
     # -- inspection ----------------------------------------------------------
 

@@ -70,6 +70,7 @@ class StallWatchdog:
         self.deadline = float(deadline_seconds)
         self._tracer = tracer or spans_lib.TRACER
         self._on_stall = on_stall or _default_on_stall
+        self._extra_on_stall: List[Callable[[StallEvent], None]] = []
         self.events: List[StallEvent] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -143,11 +144,23 @@ class StallWatchdog:
         self.events.append(ev)
         REGISTRY.counter("stalls", "ticks that overran the watchdog deadline"
                          ).inc(label=label)
-        try:
-            self._on_stall(ev)
-        except Exception:
-            pass  # a broken sink must not kill the monitor thread
+        for sink in [self._on_stall, *self._extra_on_stall]:
+            try:
+                sink(ev)
+            except Exception:
+                pass  # a broken sink must not kill the monitor thread
         return ev
+
+    def add_on_stall(self, fn: Callable[[StallEvent], None]) -> None:
+        """Chain an extra stall sink after ``on_stall`` (the flight
+        recorder hangs its dump-on-stall here without displacing the
+        stderr diagnostic)."""
+        self._extra_on_stall.append(fn)
+
+    def remove_on_stall(self, fn: Callable[[StallEvent], None]) -> None:
+        # equality, not identity: bound methods are rebuilt per access
+        self._extra_on_stall = [f for f in self._extra_on_stall
+                                if f != fn]
 
 
 # -- process-default arming (how the coordinator finds the watchdog) ---------

@@ -1,0 +1,134 @@
+"""Perf-regression gate: machine-check a fresh run against a baseline.
+
+The BENCH_r01-r05 trajectory and every RunReport in results/ are JSON on
+disk that only a human rereads; this gate makes the comparison a CI
+step. It diffs a current artifact against a committed baseline with
+per-metric tolerance bands (obs/diff.py) and exits nonzero on
+regression.
+
+Both artifact shapes are accepted on either side — a RunReport
+(``--telemetry-out``) or a bench record (``bench.py`` stdout /
+``BENCH_*.json``, whose driver wrapper shape ``{"parsed": {...}}`` is
+unwrapped automatically). Provenance is honored: a record flagged
+``needs_recapture``/``stale`` — or whose commit-stamped measured paths
+changed since capture (utils/provenance.py) — gates as **"skipped
+(stale)"**, never "ok": a stale anchor proves nothing either way.
+
+Usage:
+  python scripts/perf_gate.py BASELINE.json CURRENT.json
+  python scripts/perf_gate.py BENCH_r05.json fresh_bench.json --informational
+  python scripts/perf_gate.py base_report.json run.json --tolerance 0.4 \\
+      --tol phase/=1.0 --tol step/best_cell_updates_per_sec=0.2
+
+Exit codes: 0 = ok or skipped(stale), 1 = regression, 2 = unusable input.
+``--informational`` always exits 0 (CI's warm-up mode — report, don't
+block) but still prints the real verdict. Stdlib only; loads the differ
+and provenance modules standalone (no package import, no jax) so it
+works while a TPU tunnel is wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "gameoflifewithactors_tpu")
+
+
+def _load_module(name: str, path: str):
+    """Import one file WITHOUT the package __init__ (which imports jax —
+    a hang when the tunnel is wedged; this gate must stay jax-free)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolves annotations via here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_record(path: str) -> dict:
+    with open(path) as f:
+        rec = json.load(f)
+    if not isinstance(rec, dict):
+        raise SystemExit(f"{path}: expected a JSON object, got "
+                         f"{type(rec).__name__}")
+    # BENCH_rNN.json driver wrappers carry the measurement under "parsed"
+    if "metric" not in rec and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("baseline", help="committed baseline JSON (RunReport "
+                                     "or bench record / BENCH_*.json)")
+    ap.add_argument("current", help="fresh artifact to check")
+    ap.add_argument("--tolerance", type=float, default=None, metavar="F",
+                    help="default relative tolerance band (e.g. 0.3 = "
+                         "±30%%); per-metric defaults in obs/diff.py")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC_PREFIX=F",
+                    help="per-metric tolerance override (repeatable), "
+                         "e.g. --tol phase/=1.0")
+    ap.add_argument("--informational", action="store_true",
+                    help="report but never block: exit 0 even on "
+                         "regression (CI warm-up mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict + rows as one JSON object")
+    args = ap.parse_args(argv)
+
+    diff_lib = _load_module("_gate_diff",
+                            os.path.join(_PKG, "obs", "diff.py"))
+    try:
+        prov = _load_module("_gate_provenance",
+                            os.path.join(_PKG, "utils", "provenance.py"))
+    except Exception:
+        prov = None  # no git / moved tree: PR-2 flags still honored
+
+    overrides = {}
+    for item in args.tol:
+        if "=" not in item:
+            ap.error(f"--tol wants METRIC_PREFIX=F, got {item!r}")
+        k, v = item.split("=", 1)
+        overrides[k] = float(v)
+
+    try:
+        baseline = _load_record(args.baseline)
+        current = _load_record(args.current)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"perf gate: unusable input — {exc}", file=sys.stderr)
+        return 2
+
+    kw = {"tolerances": overrides, "provenance": prov}
+    if args.tolerance is not None:
+        kw["default_tolerance"] = args.tolerance
+    verdict = diff_lib.gate(baseline, current, **kw)
+
+    status = verdict["status"]
+    label = {"ok": "ok", "regression": "REGRESSION",
+             "skipped": "skipped (stale)"}.get(status, status)
+    if args.json:
+        print(json.dumps({
+            "perf_gate": True, "status": status, "label": label,
+            "informational": args.informational,
+            "reason": verdict["reason"],
+            "baseline": args.baseline, "current": args.current,
+            "rows": [r.to_dict() for r in verdict["rows"]],
+        }, indent=1))
+    else:
+        if verdict["rows"]:
+            print("\n".join(diff_lib.format_rows(verdict["rows"])))
+        print(f"perf gate: {label} — {verdict['reason']}")
+    if status == "regression" and not args.informational:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

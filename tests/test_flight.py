@@ -1,0 +1,205 @@
+"""Flight recorder (obs/flight.py): the black box that dumps on trouble.
+
+Covers the ISSUE-3 acceptance points: dump-on-stall (chained off the
+watchdog, naming the last-completed span), dump-on-signal (in-process
+handler chain, plus a real SIGTERM against a stepping CLI run), the
+coordinator-loop exception hook, the bounded tape, and the clean-exit
+path leaving no dump.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gameoflifewithactors_tpu.obs import flight as flight_lib
+from gameoflifewithactors_tpu.obs import spans as spans_lib
+from gameoflifewithactors_tpu.obs.compile import CompileEvent, CompileEventLog
+from gameoflifewithactors_tpu.obs.flight import FlightRecorder, load_dump
+from gameoflifewithactors_tpu.obs.watchdog import StallWatchdog
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tape_is_bounded_and_dump_round_trips(tmp_path):
+    tr = spans_lib.SpanTracer()
+    log = CompileEventLog()
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"), max_records=4,
+                        tracer=tr, compile_log=log)
+    fr.install(signals=False)
+    try:
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+            fr.on_step({"generation": i, "generations_stepped": 1,
+                        "wall_seconds": 0.01,
+                        "cell_updates_per_sec": 1e6})
+        log.record(CompileEvent(
+            runner="r", signature="uint32[8,8]", wall_seconds=0.5,
+            cache_miss=True, donated=False, t0=0.0, t1=0.5))
+        path = fr.dump("unit test")
+    finally:
+        fr.uninstall()
+    d = load_dump(path)
+    assert d["flight"]["reason"] == "unit test"
+    assert d["flight"]["last_completed_span"] == "s9"
+    # bounded tape: only the last 4 of each survive
+    assert [m["generation"] for m in d["step_metrics"]] == [6, 7, 8, 9]
+    assert [s["name"] for s in d["span"]] == ["s6", "s7", "s8", "s9"]
+    assert [c["runner"] for c in d["compile_event"]] == ["r"]
+    assert "registry" in d
+    # a second dump overwrites with fresher tape, not appends
+    with tr.span("s10"):
+        pass
+    d2 = load_dump(fr.dump("again"))
+    assert d2["flight"]["last_completed_span"] == "s10"
+    assert fr.dumps == 2
+
+
+def test_listener_taps_survive_tracer_clear(tmp_path):
+    """The tape is live-tapped: clearing the tracer (a fresh telemetry
+    session does) must not erase what the recorder already taped."""
+    tr = spans_lib.SpanTracer()
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"), tracer=tr)
+    fr.install(signals=False)
+    try:
+        with tr.span("before.clear"):
+            pass
+        tr.clear()
+        d = load_dump(fr.dump("post-clear"))
+    finally:
+        fr.uninstall()
+    assert [s["name"] for s in d["span"]] == ["before.clear"]
+    # uninstalled: later spans are not taped
+    with tr.span("after.uninstall"):
+        pass
+    d2 = load_dump(fr.dump("detached"))
+    assert [s["name"] for s in d2["span"]] == ["before.clear"]
+
+
+def test_dump_on_watchdog_stall_names_last_span(tmp_path):
+    tr = spans_lib.SpanTracer()
+    wd = StallWatchdog(0.05, tracer=tr, on_stall=lambda ev: None)
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"), tracer=tr)
+    fr.install(signals=False, watchdog=wd)
+    try:
+        with wd:
+            with tr.span("engine.step"):
+                pass
+            with wd.watch("tick@gen0+1"):
+                deadline = time.perf_counter() + 2.0
+                while not fr.dumps and time.perf_counter() < deadline:
+                    time.sleep(0.01)
+    finally:
+        fr.uninstall()
+    assert fr.dumps == 1
+    d = load_dump(fr.path)
+    assert d["flight"]["reason"] == "watchdog stall: tick@gen0+1"
+    assert d["flight"]["last_completed_span"] == "engine.step"
+    assert d["stall"][0]["label"] == "tick@gen0+1"
+
+
+def test_dump_on_signal_chains_previous_handler(tmp_path):
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"))
+    try:
+        fr.install(signals=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.perf_counter() + 2.0
+        while not got and time.perf_counter() < deadline:
+            time.sleep(0.01)
+    finally:
+        fr.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+    assert got == [signal.SIGTERM], "previous handler must still run"
+    assert fr.dumps == 1 and fr.last_dump_reason == "signal SIGTERM"
+    assert load_dump(fr.path)["flight"]["reason"] == "signal SIGTERM"
+    # uninstall restored the pre-install handler
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_coordinator_exception_leaves_dump(tmp_path):
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+
+    coord = GridCoordinator((24, 32), "B3/S23", random_fill=0.3)
+    fr = flight_lib.arm(FlightRecorder(str(tmp_path / "f.jsonl")))
+    try:
+        coord.subscribe(lambda frame: (_ for _ in ()).throw(
+            RuntimeError("subscriber died")))
+        with pytest.raises(RuntimeError, match="subscriber died"):
+            coord.tick(1)
+    finally:
+        flight_lib.disarm()
+    assert flight_lib.active_flight_recorder() is None
+    d = load_dump(fr.path)
+    assert d["flight"]["reason"].startswith(
+        "exception in coordinator loop: RuntimeError")
+    # the taped spans show how far the tick got before dying
+    assert any(s["name"] == "engine.step" for s in d["span"])
+
+
+def test_telemetry_session_clean_exit_leaves_no_dump(tmp_path):
+    from gameoflifewithactors_tpu.coordinator import GridCoordinator
+    from gameoflifewithactors_tpu.obs.report import begin_run_telemetry
+
+    flight = str(tmp_path / "f.jsonl")
+    telem = begin_run_telemetry(stall_deadline=30.0, flight_path=flight)
+    assert flight_lib.active_flight_recorder() is telem.flight
+    coord = GridCoordinator((24, 32), "B3/S23", random_fill=0.3)
+    telem.attach(coord)
+    coord.run(4)
+    rep = telem.finish(engine=coord.engine)
+    assert flight_lib.active_flight_recorder() is None
+    assert not os.path.exists(flight), "clean runs leave no crash report"
+    assert rep.step_metrics  # the session still reported normally
+
+
+def test_cli_sigterm_leaves_flight_dump(tmp_path):
+    """The acceptance scenario end-to-end: SIGTERM a *stepping* CLI run;
+    the process dies by the signal AND leaves a flight dump naming the
+    last completed span and the final StepMetrics window."""
+    out = tmp_path / "run.json"
+    cmd = [sys.executable, "-m", "gameoflifewithactors_tpu",
+           "--grid", "64x64", "--seed", "random", "--steps", "1000000",
+           "--rate", "25", "--metrics", "jsonl",
+           "--telemetry-out", str(out)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(cmd, cwd=_REPO, env=env, stderr=subprocess.PIPE,
+                         text=True)
+    try:
+        # --metrics jsonl streams a record per tick to stderr: the first
+        # one proves the run is stepping (past construction + compile)
+        deadline = time.time() + 120
+        for line in p.stderr:
+            if '"generation"' in line or time.time() > deadline:
+                break
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert rc == -signal.SIGTERM, "handler must re-raise, not swallow"
+    flight = str(out) + ".flight.jsonl"
+    assert os.path.exists(flight)
+    d = load_dump(flight)
+    assert d["flight"]["reason"] == "signal SIGTERM"
+    assert d["flight"]["last_completed_span"]
+    assert d["step_metrics"], "final StepMetrics window must be taped"
+    assert d["step_metrics"][-1]["generation"] >= 1
+    assert not os.path.exists(str(out)), \
+        "a killed run has no RunReport — the flight dump IS the artifact"
+
+
+def test_load_dump_tolerates_blank_and_unknown_lines(tmp_path):
+    path = tmp_path / "d.jsonl"
+    path.write_text('{"type": "flight", "reason": "x"}\n\n'
+                    '{"type": "mystery", "a": 1}\n')
+    d = load_dump(str(path))
+    assert d["flight"]["reason"] == "x"
+    assert d["span"] == []
