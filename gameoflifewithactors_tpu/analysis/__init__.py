@@ -1,0 +1,41 @@
+"""goltpu-lint: TPU-invariant static analysis + opt-in runtime sanitizers.
+
+Two halves with one job — *prevent* the failure classes obs/ can only
+report: silent device→host transfers in hot paths, accidental retraces
+of warmed runners, jit boundaries that escape compile accounting, and
+lock slips in the telemetry recorders.
+
+- :mod:`.lint` / :mod:`.rules` — the jax-free AST engine and the GOL00x
+  rule set (``scripts/lint.py`` is the CLI; README "Static analysis &
+  sanitizers" has the rule table and pragma syntax). Importing these
+  must work on a box with no jax at all: the CI lint job runs before
+  any dependency install.
+- :mod:`.sanitizers` — ``GOLTPU_SANITIZE=1`` runtime checks: the
+  device→host transfer guard around the engine step loop (with
+  declared allow-scopes at every sanctioned readback) and the
+  retrace-budget assertion over PR 2's compile-event attribution.
+  jax is imported lazily inside the scopes that need it.
+"""
+
+from .lint import (  # noqa: F401
+    Finding,
+    LintResult,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+from .sanitizers import (  # noqa: F401
+    ENV_SANITIZE,
+    RetraceError,
+    RetraceSentinel,
+    allow_host_transfers,
+    no_implicit_host_transfers,
+    retrace_budget,
+)
+
+__all__ = [
+    "Finding", "LintResult", "RULES", "lint_paths", "lint_source",
+    "ENV_SANITIZE", "RetraceError", "RetraceSentinel",
+    "allow_host_transfers", "no_implicit_host_transfers",
+    "retrace_budget",
+]

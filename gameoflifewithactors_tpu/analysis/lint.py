@@ -1,0 +1,367 @@
+"""goltpu-lint engine: AST rules, pragmas, baseline — stdlib only.
+
+The telemetry stack (obs/, PR 1-3) *reports* the failure classes that
+kill TPU throughput — silent device→host syncs, accidental retraces,
+lock slips in the recorders — after they happen. This module is the
+preventive half: a static-analysis engine over the package's own source
+that machine-checks the invariants the hot path depends on, cheap enough
+to run on every commit with **no jax installed** (the CI lint job runs
+before the dependency install; importing this module must never touch
+jax, numpy, or the device).
+
+Three layers:
+
+- **Rule registry** — rules register under a stable ``GOLxxx`` code via
+  :func:`register`; each is a callable ``(ModuleContext) -> [Finding]``.
+  The codes are API: pragmas and baselines reference them, so a rule may
+  be retired but its code never reused.
+- **Pragmas** — ``# goltpu: ignore[GOL006] -- reason`` suppresses
+  matching findings on its own line or the line directly below a
+  standalone pragma comment. The reason is mandatory: a suppression
+  without a written justification is itself a finding (GOL000), because
+  an unexplained ignore is where the next silent transfer hides.
+- **Baseline** — a committed JSON file of grandfathered findings
+  (matched by ``(code, path, message)`` so line drift does not
+  invalidate it). New code must lint clean; the baseline exists so the
+  tool could have been adopted mid-stream — this repo ships with it
+  empty and intends to keep it that way.
+
+``scripts/lint.py`` is the CLI face (exit 1 on unsuppressed findings,
+0 clean, 2 bad input); tests/test_lint.py pins every rule's positive and
+negative fixtures plus the whole-tree "repo is clean" smoke.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+BASELINE_VERSION = 1
+
+# the engine's own code: pragma/baseline bookkeeping problems. Not a
+# registered rule — it cannot be pragma-suppressed (fix the pragma).
+PRAGMA_ERROR_CODE = "GOL000"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*goltpu:\s*ignore\[(?P<codes>[^\]]*)\]"
+    r"(?:\s*--\s*(?P<reason>.*\S))?")
+_CODE_RE = re.compile(r"^GOL\d{3}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    code: str
+    path: str          # as handed to the engine (relative paths keep the
+                       # baseline portable across checkouts)
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with unrelated edits, so
+        grandfathering matches on (code, path, message)."""
+        return (self.code, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.code} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[["ModuleContext"], Iterable[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(code: str, name: str, summary: str):
+    """Decorator: file a rule under ``code`` (stable, never reused)."""
+    if not _CODE_RE.match(code):
+        raise ValueError(f"rule code must match GOLnnn, got {code!r}")
+
+    def deco(fn):
+        if code in RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        RULES[code] = Rule(code=code, name=name, summary=summary, check=fn)
+        return fn
+
+    return deco
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    """Everything a rule may look at for one source file."""
+
+    path: str                 # reporting path (normalized, '/'-separated)
+    source: str
+    tree: ast.Module
+    in_obs: bool              # under the obs/ subpackage (lock rules)
+    is_jit_choke_point: bool  # ops/_jit.py itself (exempt from GOL006)
+    in_tests: bool
+
+    @classmethod
+    def from_source(cls, source: str, path: str) -> "ModuleContext":
+        norm = path.replace(os.sep, "/")
+        return cls(
+            path=norm,
+            source=source,
+            tree=ast.parse(source, filename=path),
+            in_obs="/obs/" in norm or norm.startswith("obs/"),
+            is_jit_choke_point=norm.endswith("ops/_jit.py"),
+            in_tests="/tests/" in norm or norm.startswith("tests/"),
+        )
+
+    def finding(self, code: str, node: ast.AST, message: str) -> Finding:
+        return Finding(code=code, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message)
+
+
+# -- pragmas ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    line: int                 # 1-based line the comment sits on
+    codes: Tuple[str, ...]
+    reason: Optional[str]
+    standalone: bool          # comment-only line: applies to the next line
+
+
+def parse_pragmas(source: str) -> List[Pragma]:
+    """Pragmas live in COMMENT tokens only — a regex over raw lines would
+    also match the pragma syntax quoted inside string literals (this
+    module's own docstrings being exhibit A)."""
+    import io
+    import tokenize
+
+    out = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return out  # the ast parse decides whether the file is bad input
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        codes = tuple(c.strip() for c in m.group("codes").split(",")
+                      if c.strip())
+        out.append(Pragma(
+            line=tok.start[0], codes=codes, reason=m.group("reason"),
+            standalone=tok.line[:tok.start[1]].strip() == ""))
+    return out
+
+
+def _pragma_errors(pragmas: List[Pragma], path: str) -> List[Finding]:
+    errs = []
+    for p in pragmas:
+        bad = [c for c in p.codes if not _CODE_RE.match(c)]
+        if bad or not p.codes:
+            errs.append(Finding(
+                code=PRAGMA_ERROR_CODE, path=path, line=p.line, col=0,
+                message="malformed pragma: expected "
+                        "'# goltpu: ignore[GOLnnn] -- reason'"
+                        + (f" (bad code(s): {', '.join(bad)})" if bad
+                           else " (no codes)")))
+        if p.reason is None:
+            errs.append(Finding(
+                code=PRAGMA_ERROR_CODE, path=path, line=p.line, col=0,
+                message="pragma without a reason: every suppression must "
+                        "say why ('-- <reason>')"))
+    return errs
+
+
+def _suppressed_by(finding: Finding, by_line: Dict[int, List[Pragma]]) -> bool:
+    """A well-formed pragma suppresses findings on its own line, and — when
+    it is a standalone comment line — on the line directly below."""
+    candidates = list(by_line.get(finding.line, []))
+    candidates += [p for p in by_line.get(finding.line - 1, [])
+                   if p.standalone]
+    return any(finding.code in p.codes and p.reason is not None
+               and all(_CODE_RE.match(c) for c in p.codes)
+               for p in candidates)
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[dict]:
+    """Parse a baseline file; raises BaselineError on malformed input
+    (the CLI maps that to exit 2 — a broken baseline silently
+    grandfathering nothing, or everything, is worse than failing)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: expected {{'version': {BASELINE_VERSION}, "
+            "'findings': [...]}")
+    entries = data.get("findings")
+    if not isinstance(entries, list) or not all(
+            isinstance(e, dict) and {"code", "path", "message"} <= set(e)
+            for e in entries):
+        raise BaselineError(
+            f"{path}: each finding needs code/path/message keys")
+    return entries
+
+
+def baseline_payload(findings: Iterable[Finding]) -> dict:
+    """What ``scripts/lint.py --write-baseline`` writes: current findings
+    as grandfathered entries (sorted, line recorded for humans only)."""
+    return {
+        "version": BASELINE_VERSION,
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.code))],
+    }
+
+
+class BaselineError(ValueError):
+    """Unusable baseline file (CLI exit 2)."""
+
+
+# -- the engine ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FileReport:
+    path: str
+    findings: List[Finding]            # unsuppressed (pre-baseline)
+    suppressed: List[Finding]          # pragma'd out
+    error: Optional[str] = None        # unreadable / unparseable
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]            # after pragmas AND baseline
+    suppressed: List[Finding]          # by pragma
+    baselined: List[Finding]           # grandfathered
+    unused_baseline: List[dict]        # stale grandfather entries
+    files: List[FileReport]
+    errors: List[str]                  # bad-input problems (CLI exit 2)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "unused_baseline": list(self.unused_baseline),
+            "errors": list(self.errors),
+            "files_scanned": len([r for r in self.files if r.error is None]),
+        }
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Dict[str, Rule]] = None) -> FileReport:
+    """Lint one file's source. SyntaxError surfaces as FileReport.error
+    (bad input), never as an exception — the CLI keeps scanning."""
+    rules = RULES if rules is None else rules
+    try:
+        ctx = ModuleContext.from_source(source, path)
+    except SyntaxError as exc:
+        return FileReport(path=path, findings=[], suppressed=[],
+                          error=f"{path}: not parseable as Python: {exc}")
+    pragmas = parse_pragmas(source)
+    by_line: Dict[int, List[Pragma]] = {}
+    for p in pragmas:
+        by_line.setdefault(p.line, []).append(p)
+    raw: List[Finding] = list(_pragma_errors(pragmas, ctx.path))
+    for rule in rules.values():
+        raw.extend(rule.check(ctx))
+    findings, suppressed = [], []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.code)):
+        if f.code != PRAGMA_ERROR_CODE and _suppressed_by(f, by_line):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    return FileReport(path=ctx.path, findings=findings, suppressed=suppressed)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths: Iterable[str], *,
+               baseline: Optional[List[dict]] = None,
+               rules: Optional[Dict[str, Rule]] = None) -> LintResult:
+    """Lint files/trees; apply the baseline; aggregate."""
+    reports: List[FileReport] = []
+    errors: List[str] = []
+    seen = set()
+    any_path = False
+    for path in paths:
+        any_path = True
+        if not os.path.exists(path):
+            errors.append(f"{path}: no such file or directory")
+            continue
+        for fp in iter_python_files([path]):
+            if fp in seen:
+                continue
+            seen.add(fp)
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError as exc:
+                reports.append(FileReport(path=fp, findings=[],
+                                          suppressed=[],
+                                          error=f"{fp}: {exc}"))
+                continue
+            reports.append(lint_source(src, fp, rules=rules))
+    if not any_path:
+        errors.append("no paths given")
+    baseline_keys = {(e["code"], e["path"], e["message"])
+                     for e in (baseline or [])}
+    matched_keys = set()
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    for rep in reports:
+        if rep.error:
+            errors.append(rep.error)
+            continue
+        suppressed.extend(rep.suppressed)
+        for f in rep.findings:
+            if f.key() in baseline_keys:
+                matched_keys.add(f.key())
+                baselined.append(f)
+            else:
+                findings.append(f)
+    unused = [e for e in (baseline or [])
+              if (e["code"], e["path"], e["message"]) not in matched_keys]
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined, unused_baseline=unused,
+                      files=reports, errors=errors)
+
+
+# registering the built-in rules populates RULES as a side effect; the
+# import sits at the bottom so rules.py can import the registry above
+from . import rules as _rules  # noqa: E402,F401  (registration import)

@@ -1,0 +1,498 @@
+"""The built-in goltpu-lint rules (GOL001…GOL006).
+
+Each rule encodes one invariant this codebase actually depends on — the
+failure classes the telemetry layer (obs/) can only report after the
+fact. They are deliberately *heuristic*: AST-level, no type inference,
+tuned to zero false positives on this tree (tests/test_lint.py pins a
+positive and a negative fixture per rule). When a rule cannot decide, it
+stays quiet — a linter that cries wolf gets pragma'd into silence, which
+is worse than a narrow one.
+
+| code   | invariant                                                    |
+| ------ | ------------------------------------------------------------ |
+| GOL001 | no host-sync calls (.item()/float()/np.asarray/print) on     |
+|        | traced values inside jit/shard_map/lax bodies                |
+| GOL002 | no Python ``if``/``while`` on traced (non-static) arguments  |
+|        | inside traced bodies                                         |
+| GOL003 | no unconditional buffer donation at a jit boundary —         |
+|        | donation is a caller opt-in (ops/_jit.py)                    |
+| GOL004 | obs/ classes that own a ``_lock`` mutate their shared        |
+|        | ``self._*`` state only under it                              |
+| GOL005 | no raw ``time.time()`` — intervals use ``perf_counter``,     |
+|        | phases use obs.spans; wall-clock stamps carry a pragma       |
+| GOL006 | no bare ``jax.jit`` outside the ops/_jit.py choke point —    |
+|        | untracked jits silently escape compile-event accounting      |
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .lint import Finding, ModuleContext, register
+
+# ``x.shape``/``x.dtype``-style reads are trace-time constants even on a
+# traced array: branching on them is fine, syncing on them impossible
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "weak_type"}
+
+# the repo's own jit entry-point decorator and its default statics
+# (ops/_jit.py optionally_donated)
+_OPTIONALLY_DONATED_DEFAULT_STATIC = ("rule", "topology")
+
+# list/dict/set/deque mutators for the lock-discipline rule
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "update", "setdefault", "add", "discard"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.fori_loop' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d in ("jax.jit", "jit")
+
+
+def _is_tracked_jit(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and d.split(".")[-1] == "tracked_jit"
+
+
+def _is_shard_map(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and d.split(".")[-1] == "shard_map"
+
+
+def _is_partial(node: ast.AST) -> bool:
+    return _dotted(node) in ("partial", "functools.partial")
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return None
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+def _static_names_from_jit_kwargs(keywords, params: List[str]) -> Set[str]:
+    static: Set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            static |= set(_const_str_tuple(kw.value) or ())
+        elif kw.arg == "static_argnums":
+            for i in _const_int_tuple(kw.value) or ():
+                if 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+class _TracedFn:
+    """A function/lambda whose body runs under trace (jit / shard_map /
+    lax control flow), with the param names that are NOT static."""
+
+    def __init__(self, fn: ast.AST, static: Set[str], why: str):
+        self.fn = fn
+        self.params = _param_names(fn)
+        self.traced_params = [p for p in self.params if p not in static]
+        self.why = why  # "jit" / "shard_map" / "lax.scan" ... (messages)
+
+
+def _collect_traced(tree: ast.Module) -> List[_TracedFn]:
+    """Find every function the heuristic can PROVE is traced: decorated
+    with jit/shard_map (directly or via partial/optionally_donated), or
+    passed by name/inline into jax.jit()/shard_map()/lax control flow."""
+    defs_by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # last definition wins; good enough for lint purposes
+            defs_by_name[node.name] = node
+
+    traced: Dict[int, _TracedFn] = {}
+
+    def add(fn: Optional[ast.AST], static: Set[str], why: str) -> None:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)) and id(fn) not in traced:
+            traced[id(fn)] = _TracedFn(fn, static, why)
+
+    def resolve(node: ast.AST) -> Optional[ast.AST]:
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            return defs_by_name.get(node.id)
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = _param_names(node)
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec) or _is_shard_map(dec) \
+                        or _is_tracked_jit(dec):
+                    add(node, set(), "shard_map" if _is_shard_map(dec)
+                        else "jit")
+                elif isinstance(dec, ast.Call):
+                    f = dec.func
+                    if _is_jax_jit(f) or _is_tracked_jit(f):
+                        add(node, _static_names_from_jit_kwargs(
+                            dec.keywords, params), "jit")
+                    elif _is_shard_map(f):
+                        add(node, set(), "shard_map")
+                    elif _is_partial(f) and dec.args and (
+                            _is_jax_jit(dec.args[0])
+                            or _is_tracked_jit(dec.args[0])
+                            or _is_shard_map(dec.args[0])):
+                        static = (set() if _is_shard_map(dec.args[0])
+                                  else _static_names_from_jit_kwargs(
+                                      dec.keywords, params))
+                        add(node, static,
+                            "shard_map" if _is_shard_map(dec.args[0])
+                            else "jit")
+                    elif _dotted(f) is not None and \
+                            _dotted(f).split(".")[-1] == "optionally_donated":
+                        static = set(_OPTIONALLY_DONATED_DEFAULT_STATIC)
+                        for kw in dec.keywords:
+                            if kw.arg == "static":
+                                static = set(_const_str_tuple(kw.value)
+                                             or static)
+                        add(node, static, "jit")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            fname = _dotted(f)
+            if (_is_jax_jit(f) or _is_tracked_jit(f)) and node.args:
+                fn = resolve(node.args[0])
+                if fn is not None:
+                    add(fn, _static_names_from_jit_kwargs(
+                        node.keywords, _param_names(fn)), "jit")
+            elif _is_shard_map(f) and node.args:
+                fn = resolve(node.args[0])
+                add(fn, set(), "shard_map")
+            elif fname is not None:
+                tail = fname.split(".")[-1]
+                # positions of the traced callee(s) per lax primitive
+                callee_slots = {"scan": (0,), "fori_loop": (2,),
+                                "while_loop": (0, 1), "cond": (1, 2),
+                                "map": (0,), "associative_scan": (0,),
+                                "checkpoint": (0,)}.get(tail)
+                if callee_slots and ("lax" in fname.split(".")
+                                     or tail == "checkpoint"):
+                    for slot in callee_slots:
+                        if slot < len(node.args):
+                            add(resolve(node.args[slot]), set(),
+                                f"lax.{tail}")
+                elif tail == "switch" and "lax" in fname.split(".") \
+                        and len(node.args) > 1 and isinstance(
+                            node.args[1], (ast.List, ast.Tuple)):
+                    for e in node.args[1].elts:
+                        add(resolve(e), set(), "lax.switch")
+    return list(traced.values())
+
+
+def _names_in(node: ast.AST, targets: Set[str],
+              skip_static_attr_roots: bool = True) -> List[ast.Name]:
+    """Name nodes in ``node`` matching ``targets`` — excluding names that
+    only appear as the root of a static-attribute read (``x.shape``), a
+    ``isinstance(x, ...)`` probe, or an ``is``/``is not`` comparison
+    (all trace-time constants)."""
+    skip: Set[int] = set()
+
+    class _Marker(ast.NodeVisitor):
+        def visit_Attribute(self, n: ast.Attribute) -> None:
+            if skip_static_attr_roots and n.attr in _STATIC_ATTRS \
+                    and isinstance(n.value, ast.Name):
+                skip.add(id(n.value))
+            self.generic_visit(n)
+
+        def visit_Call(self, n: ast.Call) -> None:
+            if isinstance(n.func, ast.Name) and \
+                    n.func.id in ("isinstance", "len", "type", "getattr",
+                                  "hasattr"):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        skip.add(id(sub))
+            self.generic_visit(n)
+
+        def visit_Compare(self, n: ast.Compare) -> None:
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        skip.add(id(sub))
+            self.generic_visit(n)
+
+    _Marker().visit(node)
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Name) and n.id in targets
+            and id(n) not in skip]
+
+
+# -- GOL001: host sync inside traced code -------------------------------------
+
+
+@register("GOL001", "host-sync-in-jit",
+          "no device→host sync calls inside jit/shard_map/lax bodies")
+def _host_sync_in_jit(ctx: ModuleContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for tf in _collect_traced(ctx.tree):
+        traced = set(tf.traced_params)
+        body = tf.fn.body if isinstance(tf.fn, ast.Lambda) else tf.fn
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                out.append(ctx.finding(
+                    "GOL001", node,
+                    f".item() inside a traced ({tf.why}) body forces a "
+                    "device→host sync per trace; fetch after the "
+                    "dispatch, outside the jit boundary"))
+            elif isinstance(f, ast.Attribute) and f.attr in (
+                    "asarray", "array") and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy"):
+                out.append(ctx.finding(
+                    "GOL001", node,
+                    f"np.{f.attr}() inside a traced ({tf.why}) body pulls "
+                    "the traced value to host (ConcretizationTypeError at "
+                    "best, a silent transfer at worst); use jnp, or move "
+                    "the readback outside the jit"))
+            elif isinstance(f, ast.Name) and f.id == "print":
+                out.append(ctx.finding(
+                    "GOL001", node,
+                    f"print() inside a traced ({tf.why}) body runs at "
+                    "trace time (or syncs on the traced value); use "
+                    "jax.debug.print for runtime values"))
+            elif isinstance(f, ast.Name) and f.id in ("float", "int",
+                                                      "bool") \
+                    and node.args and _names_in(node.args[0], traced):
+                out.append(ctx.finding(
+                    "GOL001", node,
+                    f"{f.id}() on traced argument inside a traced "
+                    f"({tf.why}) body is a concretizing device→host "
+                    "sync; keep the value on device or make the "
+                    "argument static"))
+    return out
+
+
+# -- GOL002: Python branching on traced values --------------------------------
+
+
+@register("GOL002", "traced-branch",
+          "no Python if/while on traced (non-static) args in traced bodies")
+def _traced_branch(ctx: ModuleContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for tf in _collect_traced(ctx.tree):
+        traced = set(tf.traced_params)
+        if not traced:
+            continue
+        body = tf.fn.body if isinstance(tf.fn, ast.Lambda) else tf.fn
+        for node in ast.walk(body):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            hits = _names_in(node.test, traced)
+            if hits:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(ctx.finding(
+                    "GOL002", node,
+                    f"Python `{kind}` on traced argument "
+                    f"'{hits[0].id}' inside a traced ({tf.why}) body — "
+                    "this concretizes (TracerBoolConversionError) or "
+                    "bakes one branch into the trace; use lax.cond/"
+                    "lax.select, or mark the argument static"))
+    return out
+
+
+# -- GOL003: unconditional buffer donation ------------------------------------
+
+
+@register("GOL003", "unconditional-donation",
+          "donation at a jit boundary must be a caller opt-in")
+def _unconditional_donation(ctx: ModuleContext) -> Iterable[Finding]:
+    if ctx.is_jit_choke_point:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_jitlike = _is_jax_jit(f) or (
+            _dotted(f) is not None
+            and _dotted(f).split(".")[-1] == "tracked_jit") or (
+            _is_partial(f) and node.args and (
+                _is_jax_jit(node.args[0])
+                or (_dotted(node.args[0]) or "").split(".")[-1]
+                == "tracked_jit"))
+        if not is_jitlike:
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            donated = _const_int_tuple(kw.value)
+            if donated is None:
+                donated = _const_str_tuple(kw.value)
+            if donated:  # a non-empty compile-time constant: always on
+                out.append(ctx.finding(
+                    "GOL003", kw.value,
+                    f"unconditional {kw.arg}={donated!r}: donation "
+                    "consumes the caller's buffer on TPU (a no-op on "
+                    "CPU, so tests won't catch it) — make it an opt-in "
+                    "like ops/_jit.optionally_donated, e.g. "
+                    "`donate_argnums=(0,) if donate else ()`"))
+    return out
+
+
+# -- GOL004: obs/ lock discipline ---------------------------------------------
+
+
+def _lock_attr_names(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned a threading.Lock()/RLock() anywhere in the
+    class (typically __init__)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            d = _dotted(node.value.func) or ""
+            if d.split(".")[-1] in ("Lock", "RLock"):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        locks.add(t.attr)
+    return locks
+
+
+@register("GOL004", "lock-discipline",
+          "obs/ shared state mutations must hold the owning class's lock")
+def _lock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
+    if not ctx.in_obs:
+        return []
+    out: List[Finding] = []
+    for cls in [n for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)]:
+        locks = _lock_attr_names(cls)
+        if not locks:
+            continue
+
+        def check_fn(fn: ast.FunctionDef) -> None:
+            def walk(node: ast.AST, in_lock: bool) -> None:
+                if isinstance(node, ast.With):
+                    holds = any(
+                        isinstance(item.context_expr, ast.Attribute)
+                        and isinstance(item.context_expr.value, ast.Name)
+                        and item.context_expr.value.id == "self"
+                        and item.context_expr.attr in locks
+                        for item in node.items)
+                    for child in node.body:
+                        walk(child, in_lock or holds)
+                    return
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)) \
+                        and node is not fn:
+                    return  # nested scope: judged on its own if reached
+                self_attr = None
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        base = t.value if isinstance(t, ast.Subscript) \
+                            else t
+                        if isinstance(base, ast.Attribute) and isinstance(
+                                base.value, ast.Name) \
+                                and base.value.id == "self" \
+                                and base.attr.startswith("_") \
+                                and base.attr not in locks:
+                            self_attr = base.attr
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    v = node.func.value
+                    if isinstance(v, ast.Attribute) and isinstance(
+                            v.value, ast.Name) and v.value.id == "self" \
+                            and v.attr.startswith("_") \
+                            and v.attr not in locks:
+                        self_attr = v.attr
+                if self_attr is not None and not in_lock:
+                    out.append(ctx.finding(
+                        "GOL004", node,
+                        f"`self.{self_attr}` mutated outside "
+                        f"`with self.{sorted(locks)[0]}:` in "
+                        f"{cls.name}.{fn.name} — obs/ recorders are "
+                        "read from monitor/exporter threads; hold the "
+                        "lock or pragma why this access is safe"))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, in_lock)
+
+            for child in fn.body:
+                walk(child, False)
+
+        for fn in cls.body:
+            if isinstance(fn, ast.FunctionDef) and fn.name != "__init__":
+                check_fn(fn)
+    return out
+
+
+# -- GOL005: raw wall-clock timing --------------------------------------------
+
+
+@register("GOL005", "wall-clock-timing",
+          "time.time() is neither monotonic nor span-attributed")
+def _wall_clock(ctx: ModuleContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "time.time":
+            out.append(ctx.finding(
+                "GOL005", node,
+                "raw time.time(): intervals want time.perf_counter() "
+                "(monotonic), instrumented phases want obs.spans.span() "
+                "so the RunReport sees them; a genuine wall-clock stamp "
+                "needs a pragma saying so"))
+    return out
+
+
+# -- GOL006: jit outside the choke point --------------------------------------
+
+
+@register("GOL006", "untracked-jit",
+          "bare jax.jit bypasses the ops/_jit compile-accounting choke point")
+def _untracked_jit(ctx: ModuleContext) -> Iterable[Finding]:
+    if ctx.is_jit_choke_point:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "jit" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax":
+            out.append(ctx.finding(
+                "GOL006", node,
+                "bare jax.jit bypasses the ops/_jit choke point: its "
+                "compiles never become CompileEvents, so StepMetrics "
+                "mis-attributes the stall and the retrace sanitizer "
+                "cannot see it — use ops._jit.tracked_jit (or "
+                "optionally_donated for step entry points)"))
+    return out

@@ -1,0 +1,157 @@
+"""Runtime sanitizers: the dynamic half of goltpu-lint.
+
+The static rules (rules.py) catch what the AST can prove; these catch
+the rest at run time, opt-in via ``GOLTPU_SANITIZE=1`` so production
+runs pay nothing:
+
+- **Transfer guard** — :func:`no_implicit_host_transfers` wraps the
+  engine's step dispatch in ``jax.transfer_guard_device_to_host
+  ("disallow")``: any *implicit* device→host readback inside the hot
+  loop raises instead of silently serializing the pipeline. Paths that
+  legitimately pull to host (snapshot/population readback, the sparse
+  backend's per-step scalar, render/report plumbing) declare themselves
+  with :func:`allow_host_transfers(reason)` — the allow-scope IS the
+  documentation of every sanctioned sync point. Note the guard only
+  fires where a real transfer happens (TPU/GPU); on CPU the arrays are
+  host-resident and jax performs no guarded transfer, so the wiring is
+  exercised by tier-1 but the teeth only bite on hardware.
+- **Retrace budget** — a warmed engine (AOT-loaded or persistent-cache
+  served) must never pay a real XLA compile again; PR 2 made that
+  *observable* (``CompileEvent.kind == "cache_miss"``), this makes it
+  *enforced*. :class:`RetraceSentinel` taps the process
+  :data:`~..obs.compile.COMPILE_LOG`; :meth:`RetraceSentinel.check`
+  raises :class:`RetraceError` naming the runner and shape signature
+  that recompiled. ``Engine.step`` checks automatically on warmed
+  engines when sanitizing; tests use :func:`retrace_budget` directly.
+
+jax is imported lazily inside the guard scopes: this module must import
+(and the lint half of the package must run) with no jax installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, List, Optional
+
+from ..obs import compile as obs_compile
+
+ENV_SANITIZE = "GOLTPU_SANITIZE"
+_ENABLED_VALUES = ("1", "true", "on", "yes")
+
+
+def enabled() -> bool:
+    """Is the opt-in sanitizer wiring live (``GOLTPU_SANITIZE=1``)?
+    Read per call, so a test can flip it with monkeypatch.setenv."""
+    return os.environ.get(ENV_SANITIZE, "").strip().lower() \
+        in _ENABLED_VALUES
+
+
+@contextlib.contextmanager
+def no_implicit_host_transfers() -> Iterator[None]:
+    """Disallow implicit device→host transfers inside the scope (no-op
+    unless sanitizing). Explicit fetches (``jax.device_get``) stay
+    allowed — the point is catching the *silent* syncs."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def allow_host_transfers(reason: str) -> Iterator[None]:
+    """Declare a sanctioned device→host readback (snapshot, population,
+    the sparse step scalar, render/report paths). ``reason`` is
+    mandatory and unused at runtime — it exists so every allow-scope in
+    the tree reads as its own justification."""
+    if not reason:
+        raise ValueError("allow_host_transfers requires a reason string")
+    if not enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host("allow"):
+        yield
+
+
+class RetraceError(AssertionError):
+    """A warmed engine paid a real XLA compile (retrace budget blown)."""
+
+
+class RetraceSentinel:
+    """Tap the compile log; fail fast when cache_miss events exceed the
+    budget. ``arm()``/``disarm()`` bracket the watched window;
+    ``check()`` raises; ``misses()`` inspects."""
+
+    def __init__(self, budget: int = 0, *, context: str = "",
+                 log: Optional[obs_compile.CompileEventLog] = None):
+        if budget < 0:
+            raise ValueError(f"budget must be >= 0, got {budget}")
+        self.budget = budget
+        self.context = context
+        self._log = log if log is not None else obs_compile.COMPILE_LOG
+        self._events: List[obs_compile.CompileEvent] = []
+        self._lock = threading.Lock()
+        self._armed = False
+
+    def _on_event(self, ev) -> None:
+        # listener exceptions are swallowed by CompileEventLog.record,
+        # so never raise here — tape the miss, let check() do the failing
+        if getattr(ev, "cache_miss", False):
+            with self._lock:
+                self._events.append(ev)
+
+    def arm(self) -> "RetraceSentinel":
+        if not self._armed:
+            self._armed = True
+            self._log.add_listener(self._on_event)
+        return self
+
+    def disarm(self) -> None:
+        if self._armed:
+            self._armed = False
+            self._log.remove_listener(self._on_event)
+
+    def misses(self) -> List[obs_compile.CompileEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def check(self) -> None:
+        misses = self.misses()
+        if len(misses) > self.budget:
+            detail = "; ".join(
+                f"{e.runner}({e.signature}) {e.wall_seconds:.2f}s"
+                for e in misses[:4])
+            more = f" (+{len(misses) - 4} more)" if len(misses) > 4 else ""
+            raise RetraceError(
+                f"retrace budget blown{' for ' + self.context if self.context else ''}: "
+                f"{len(misses)} real XLA compile(s) after warm "
+                f"(budget {self.budget}): {detail}{more} — a warmed "
+                "engine recompiling means the AOT/persistent-cache key "
+                "or a shape/dtype signature drifted")
+
+    def __enter__(self) -> "RetraceSentinel":
+        return self.arm()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.disarm()
+        if exc_type is None:
+            self.check()
+
+
+@contextlib.contextmanager
+def retrace_budget(budget: int = 0, *, context: str = "",
+                   log: Optional[obs_compile.CompileEventLog] = None,
+                   ) -> Iterator[RetraceSentinel]:
+    """``with retrace_budget(): engine.step(n)`` — raises RetraceError on
+    exit if more than ``budget`` real compiles landed inside the scope.
+    Always live (not gated on GOLTPU_SANITIZE): the caller opting into
+    the context *is* the opt-in."""
+    sentinel = RetraceSentinel(budget, context=context, log=log)
+    with sentinel:
+        yield sentinel

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from .analysis import sanitizers as _sanitizers
 from .models.generations import GenRule, parse_any
 from .models.ltl import LtLRule
 from .models.rules import Rule
@@ -598,6 +599,17 @@ class Engine:
             if aot_run is not None:
                 self._run = aot_run
                 self.aot_loaded = True
+        # retrace sanitizer (GOLTPU_SANITIZE=1): a warm-started engine
+        # claiming zero compile cost must never pay a real XLA compile
+        # again — arm a sentinel over the process compile log and check
+        # it after every step (analysis/sanitizers.py). Cold engines are
+        # exempt: their first steps legitimately compile.
+        self._retrace_sentinel = None
+        if self.aot_loaded and _sanitizers.enabled():
+            self._retrace_sentinel = _sanitizers.RetraceSentinel(
+                context=f"warm-started engine ({self.rule.notation} "
+                        f"{self.shape[0]}x{self.shape[1]} "
+                        f"{self.backend})").arm()
 
     def _flagged_sparse_runner(self, run2, mesh: Mesh):
         """Wrap a sharded sparse runner (binary bitboard or Generations
@@ -732,10 +744,22 @@ class Engine:
         with obs_spans.span("engine.step", generations=n,
                             backend=self.backend):
             if self._sparse is not None:
-                self._sparse.step(n)
+                # the sparse backend's one-scalar-per-step readback is
+                # its documented contract (copy-free overflow design) —
+                # a declared sync point, not a silent one
+                with _sanitizers.allow_host_transfers(
+                        "sparse step reads its generations-completed "
+                        "scalar (see Engine.step docstring)"):
+                    self._sparse.step(n)
             else:
-                self._state = self._run(self._state, n)
+                # sanitizer (GOLTPU_SANITIZE=1): the dense/packed/pallas
+                # hot loop must stay transfer-free — an implicit
+                # device→host fetch here serializes the async pipeline
+                with _sanitizers.no_implicit_host_transfers():
+                    self._state = self._run(self._state, n)
         self.generation += n
+        if self._retrace_sentinel is not None:
+            self._retrace_sentinel.check()
 
     def block_until_ready(self) -> None:
         with obs_spans.span("engine.sync"):
@@ -762,7 +786,10 @@ class Engine:
         """The full grid as host uint8 (H, W); optionally block-max downsampled
         *on device* to fit within ``max_shape`` before transfer, so rendering
         a 16384² universe to an 80-column console ships ~2 KB, not 256 MB."""
-        with obs_spans.span("engine.snapshot"):
+        with obs_spans.span("engine.snapshot"), \
+                _sanitizers.allow_host_transfers(
+                    "snapshot IS the designated host readback (renderers, "
+                    "checkpoints, reports fetch here, not in the loop)"):
             if self._gen_packed:
                 from .ops.packed_generations import unpack_generations
 
@@ -935,6 +962,9 @@ class Engine:
                 # non-donating jit that degrades to a (correct) copy and
                 # a donation warning we don't want surfaced per report
                 warnings.simplefilter("ignore")
+                # this jit exists only to be lowered for cost_analysis —
+                # it is never dispatched, so no step time can hide in it
+                # goltpu: ignore[GOL006] -- introspection-only lower/compile, never dispatched
                 compiled = jax.jit(
                     lambda s: self._run(s, gens)).lower(self.state).compile()
                 ca = compiled.cost_analysis()
@@ -959,11 +989,14 @@ class Engine:
         for the per-device-flag sparse runner, whose wake granularity is
         a whole shard, not tiles). Sharded tiled engines sum the
         distributed activity map (one device reduction)."""
-        if self._sparse is not None:
-            return self._sparse.active_tiles()
-        if self._flags is not None and getattr(self, "_sparse_tiles", None):
-            return int(jnp.sum(self._flags))
-        return None
+        with _sanitizers.allow_host_transfers(
+                "active-tile count is an explicit observability readback"):
+            if self._sparse is not None:
+                return self._sparse.active_tiles()
+            if self._flags is not None and getattr(self, "_sparse_tiles",
+                                                   None):
+                return int(jnp.sum(self._flags))
+            return None
 
     def population(self) -> int:
         """Exact live-cell count (device-side popcount, host-side total).
@@ -971,15 +1004,21 @@ class Engine:
         For multi-state families (Generations; LtL with C >= 3) only
         state 1 is *alive* — dying states occupy space but are not
         population (they do not excite neighbors)."""
-        if self._packed:
-            return bitpack.population(self.state)
-        if self._gen_packed:
-            from .ops.packed_generations import population_packed_generations
+        with _sanitizers.allow_host_transfers(
+                "population is an explicit scalar readback (device-side "
+                "popcount, one host total)"):
+            if self._packed:
+                return bitpack.population(self.state)
+            if self._gen_packed:
+                from .ops.packed_generations import (
+                    population_packed_generations,
+                )
 
-            return population_packed_generations(self.state)
-        multistate = getattr(self.rule, "states", 2) > 2
-        cells = (self._state == 1) if multistate else self._state
-        return int(np.asarray(jnp.sum(cells, axis=-1, dtype=jnp.uint32)).sum())
+                return population_packed_generations(self.state)
+            multistate = getattr(self.rule, "states", 2) > 2
+            cells = (self._state == 1) if multistate else self._state
+            return int(np.asarray(
+                jnp.sum(cells, axis=-1, dtype=jnp.uint32)).sum())
 
     # -- state injection (checkpoint restore, pattern editing) ---------------
 
@@ -1025,10 +1064,10 @@ class Engine:
             self.generation = generation
 
 
-from functools import partial
+from .ops._jit import tracked_jit
 
 
-@partial(jax.jit, static_argnums=(1, 2))
+@tracked_jit(runner="_block_max", static_argnums=(1, 2))
 def _block_max(x: jax.Array, fh: int, fw: int) -> jax.Array:
     h, w = x.shape
     # pad up to a block multiple (zeros are dead cells) so edge rows/columns

@@ -94,39 +94,48 @@ class FlightRecorder:
         signal on to whatever handler was there — default die included).
         Signal handlers only install from the main thread; elsewhere the
         tape still runs, just without the signal trigger."""
-        if self._installed:
-            return self
-        self._installed = True
+        # wiring state under the lock (goltpu-lint GOL004): install/
+        # uninstall can race the signal handler and a second arm() call,
+        # and the check-then-set on _installed was a classic TOCTOU
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+            if watchdog is not None:
+                self._watchdog = watchdog
         self._tracer.add_listener(self.on_span)
         self._compile_log.add_listener(self.on_compile)
         if watchdog is not None:
-            self._watchdog = watchdog
             watchdog.add_on_stall(self.on_stall)
         if signals:
             for sig in (signal.SIGTERM, signal.SIGINT):
                 try:
-                    self._prev_handlers[sig] = signal.getsignal(sig)
+                    prev = signal.getsignal(sig)
                     signal.signal(sig, self._on_signal)
                 except (ValueError, OSError):  # not the main thread
-                    self._prev_handlers.pop(sig, None)
+                    continue
+                with self._lock:
+                    self._prev_handlers[sig] = prev
         return self
 
     def uninstall(self) -> None:
-        if not self._installed:
-            return
-        self._installed = False
+        with self._lock:
+            if not self._installed:
+                return
+            self._installed = False
+            watchdog, self._watchdog = self._watchdog, None
+            prev_handlers = dict(self._prev_handlers)
+            self._prev_handlers.clear()
         self._tracer.remove_listener(self.on_span)
         self._compile_log.remove_listener(self.on_compile)
-        if self._watchdog is not None:
-            self._watchdog.remove_on_stall(self.on_stall)
-            self._watchdog = None
-        for sig, prev in self._prev_handlers.items():
+        if watchdog is not None:
+            watchdog.remove_on_stall(self.on_stall)
+        for sig, prev in prev_handlers.items():
             try:
                 if signal.getsignal(sig) == self._on_signal:
                     signal.signal(sig, prev)
             except (ValueError, OSError):
                 pass
-        self._prev_handlers.clear()
 
     def _on_signal(self, signum, frame) -> None:
         try:

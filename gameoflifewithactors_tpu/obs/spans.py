@@ -65,6 +65,7 @@ class SpanTracer:
         self.enabled = enabled
         # perf_counter -> wall-clock anchor, so exported timestamps can be
         # correlated with a jax.profiler trace captured in the same process
+        # goltpu: ignore[GOL005] -- wall-clock is the point: this anchors perf_counter spans to epoch time for perfetto correlation
         self.epoch_anchor = time.time() - time.perf_counter()
 
     def add_listener(self, fn) -> None:

@@ -98,19 +98,23 @@ class StallWatchdog:
     # -- the monitor thread --------------------------------------------------
 
     def start(self) -> "StallWatchdog":
-        if self._thread is not None:
-            return self
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._monitor, name="stall-watchdog", daemon=True)
-        self._thread.start()
+        # lifecycle state under the lock too (goltpu-lint GOL004): two
+        # threads racing start() must not each spawn a monitor
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            t = self._thread = threading.Thread(
+                target=self._monitor, name="stall-watchdog", daemon=True)
+        t.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
 
     def __enter__(self) -> "StallWatchdog":
         return self.start()
@@ -135,6 +139,10 @@ class StallWatchdog:
             if elapsed <= self.deadline:
                 return None
             active[3] = True  # one event per stalled tick
+            # snapshot the sink chain inside the lock: add_on_stall from
+            # another thread (flight-recorder arming) must not mutate the
+            # list this poll is iterating
+            sinks = [self._on_stall, *self._extra_on_stall]
         last = self._tracer.last_completed()
         ev = StallEvent(
             label=label, elapsed_seconds=elapsed,
@@ -144,7 +152,7 @@ class StallWatchdog:
         self.events.append(ev)
         REGISTRY.counter("stalls", "ticks that overran the watchdog deadline"
                          ).inc(label=label)
-        for sink in [self._on_stall, *self._extra_on_stall]:
+        for sink in sinks:
             try:
                 sink(ev)
             except Exception:
@@ -155,12 +163,16 @@ class StallWatchdog:
         """Chain an extra stall sink after ``on_stall`` (the flight
         recorder hangs its dump-on-stall here without displacing the
         stderr diagnostic)."""
-        self._extra_on_stall.append(fn)
+        # under the lock (goltpu-lint GOL004): the monitor thread
+        # snapshots this list mid-poll
+        with self._lock:
+            self._extra_on_stall = self._extra_on_stall + [fn]
 
     def remove_on_stall(self, fn: Callable[[StallEvent], None]) -> None:
         # equality, not identity: bound methods are rebuilt per access
-        self._extra_on_stall = [f for f in self._extra_on_stall
-                                if f != fn]
+        with self._lock:
+            self._extra_on_stall = [f for f in self._extra_on_stall
+                                    if f != fn]
 
 
 # -- process-default arming (how the coordinator finds the watchdog) ---------

@@ -32,6 +32,50 @@ import jax
 from ..obs import compile as _obs_compile
 
 
+def tracked_jit(fun: Callable = None, *, runner: str = None,
+                **jit_kwargs) -> Callable:
+    """``jax.jit`` that stays inside the compile-accounting choke point.
+
+    The runner builders (parallel/sharded.py, parallel/batched.py,
+    ops/sparse.py, the pallas loop builders) historically returned bare
+    ``jax.jit`` objects — their compiles never became CompileEvents, so
+    a sharded engine's first tick hid seconds of XLA time inside
+    StepMetrics and the retrace sanitizer was blind to the whole SPMD
+    family. This wrapper is the fix and the lint rule GOL006's
+    prescription: same signature surface as ``jax.jit`` (kwargs pass
+    through), but every call routes through
+    :func:`obs.compile.tracked_call`.
+
+    Usable directly or as a decorator factory::
+
+        run = tracked_jit(_run, runner="sharded.multi_step_packed",
+                          donate_argnums=(0,) if donate else ())
+
+        @tracked_jit(runner="sparse_many", donate_argnums=(0, 1))
+        def sparse_many(padded, active, n): ...
+
+    ``.jitted`` exposes the underlying jit and ``.lower`` forwards to it,
+    so introspection sites (utils/profiling.measured_halo_bytes_per_gen,
+    AOT export) keep working on wrapped runners.
+    """
+    if fun is None:
+        return lambda f: tracked_jit(f, runner=runner, **jit_kwargs)
+    jitted = jax.jit(fun, **jit_kwargs)
+    name = runner or getattr(fun, "__name__", None) or "jit"
+    donated = bool(jit_kwargs.get("donate_argnums")
+                   or jit_kwargs.get("donate_argnames"))
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        return _obs_compile.tracked_call(jitted, name, args, kwargs,
+                                         donated=donated)
+
+    wrapper.__name__ = name
+    wrapper.jitted = jitted
+    wrapper.lower = jitted.lower  # introspection passthrough
+    return wrapper
+
+
 def optionally_donated(
     donate_arg: str, static: Tuple[str, ...] = ("rule", "topology")
 ) -> Callable:

@@ -52,6 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.rules import Rule
+from ._jit import tracked_jit
 from .stencil import Topology
 from .packed import multi_step_packed, step_packed_slab as step_rows
 
@@ -394,8 +395,9 @@ def _build_ltl_runner(rule, topology: Topology, shape, bh: int, g: int,
                       interpret: bool, donate: bool):
     call = _ltl_pallas_call(rule, topology, shape, bh, g, interpret,
                             slab_mode=False)
-    return jax.jit(
+    return tracked_jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        runner="pallas_ltl_loop",
         donate_argnums=(0,) if donate else (),
     )
 
@@ -666,8 +668,9 @@ def _build_gen_runner(rule, topology: Topology, shape, bh: int, g: int,
                       interpret: bool, donate: bool):
     call = _gen_pallas_call(rule, topology, shape, bh, g, interpret,
                             slab_mode=False)
-    return jax.jit(
+    return tracked_jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        runner="pallas_generations_loop",
         donate_argnums=(0,) if donate else (),
     )
 
@@ -895,8 +898,9 @@ def _build_runner(rule: Rule, topology: Topology, shape, bh: int, g: int,
         ],
         interpret=interpret,
     )
-    loop = jax.jit(
+    loop = tracked_jit(
         lambda s, c: jax.lax.fori_loop(0, c, lambda _, t: call(t), s),
+        runner="pallas_binary_loop",
         donate_argnums=(0,) if donate else (),
     )
     return loop

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.rules import Rule
+from ._jit import tracked_jit
 from .packed import step_packed_ext
 from .stencil import Topology
 
@@ -404,7 +405,11 @@ def _build_sparse_step(
             changed_any, mode="drop", unique_indices=True)
         return padded, active
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    # the engine owns both buffers (SparseEngineState allocates and
+    # re-threads them every step), so always-on donation is safe here —
+    # this is not a caller-facing functional entry point
+    # goltpu: ignore[GOL003] -- internal runner over engine-owned buffers
+    @partial(tracked_jit, runner="sparse_many", donate_argnums=(0, 1))
     def sparse_many(padded, active, n):
         """Run up to ``n`` CHUNKS (of ``gens`` generations) on-device;
         stop early at the first chunk whose candidate set exceeds
@@ -453,7 +458,8 @@ def _build_dense_once(
     r, rw = _rule_halo(rule)
     ring = ring_rows or r
 
-    @partial(jax.jit, donate_argnums=(0,))
+    # goltpu: ignore[GOL003] -- internal runner over engine-owned buffers
+    @partial(tracked_jit, runner="sparse_dense_once", donate_argnums=(0,))
     def dense_once(padded):
         if wrap:
             padded = _refresh_ring(padded, ring, rw)

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.rules import Rule
 from ..ops.packed import step_packed_ext
 from ..ops.stencil import Topology
+from ..ops._jit import tracked_jit
 from .halo import exchange_halo
 from .mesh import COL_AXIS, ROW_AXIS
 
@@ -74,7 +75,8 @@ def make_multi_step_packed_batched(
 
     # donation opt-in: see ops/_jit.py for why consuming the caller's batch
     # by default is a TPU-only footgun
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return tracked_jit(_run, runner="batched.multi_step_packed_batched",
+                       donate_argnums=(0,) if donate else ())
 
 
 def make_multi_step_pallas_batched(
@@ -135,4 +137,5 @@ def make_multi_step_pallas_batched(
     def _run(tiles, n):
         return jax.lax.fori_loop(0, n, lambda _, t: chunk(t), tiles)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return tracked_jit(_run, runner="batched.multi_step_pallas_batched",
+                       donate_argnums=(0,) if donate else ())

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.rules import Rule
 from ..ops import packed as packed_ops
+from ..ops._jit import tracked_jit
 from ..ops import stencil as stencil_ops
 from ..ops.stencil import Topology
 from .halo import (
@@ -40,6 +41,15 @@ from .halo import (
 from .mesh import COL_AXIS, ROW_AXIS, band_axis as _band_axis
 
 _SPEC = P(ROW_AXIS, COL_AXIS)
+
+
+def _tracked(run, runner: str, donate: bool, nargs: int = 1):
+    """Jit a shard_map runner through the compile-accounting choke point
+    (ops/_jit.tracked_jit) so sharded compiles become CompileEvents: a
+    multi-device first tick used to hide its whole XLA compile inside
+    StepMetrics.wall_seconds because these builders returned bare jits."""
+    return tracked_jit(run, runner=runner,
+                       donate_argnums=tuple(range(nargs)) if donate else ())
 
 
 def _dense_ext_step(ext: jax.Array, rule: Rule) -> jax.Array:
@@ -57,6 +67,7 @@ def _make_runner(
     multi: bool,
     depth: int = 1,
     donate: bool = False,
+    runner: str = "sharded.step",
 ) -> Callable:
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
@@ -74,21 +85,23 @@ def _make_runner(
 
     # donation is opt-in (see ops/_jit.py): only buffer owners like Engine
     # should let a runner consume the incoming grid
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, runner, donate)
 
 
 def make_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
                      donate: bool = False) -> Callable:
     """Jitted one-generation step on a 2D-sharded packed grid."""
     return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext,
-                        multi=False, donate=donate)
+                        multi=False, donate=donate,
+                        runner="sharded.step_packed")
 
 
 def make_multi_step_packed(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
                            donate: bool = False) -> Callable:
     """Jitted (grid, n) -> grid running n sharded generations on-device."""
     return _make_runner(mesh, rule, topology, packed_ops.step_packed_ext,
-                        multi=True, donate=donate)
+                        multi=True, donate=donate,
+                        runner="sharded.multi_step_packed")
 
 
 def make_multi_step_packed_sparse(
@@ -117,11 +130,11 @@ def make_multi_step_packed_sparse(
         mesh, _SPEC,
         lambda tile, nx_, ny_: exchange_halo(tile, nx_, ny_, topology),
         lambda ext: packed_ops.step_packed_ext(ext, rule),
-        topology, donate)
+        topology, donate, runner="sharded.multi_step_packed_sparse")
 
 
 def _make_flagged_sparse(mesh, state_spec, exchange, step_ext, topology,
-                         donate):
+                         donate, runner="sharded.sparse"):
     """The shared per-device activity-skipping runner for both layouts
     (2D bitboard, Generations plane stack). ``exchange(state, nx, ny)``
     runs UNCONDITIONALLY — halo ppermutes are collectives and every device
@@ -151,7 +164,7 @@ def _make_flagged_sparse(mesh, state_spec, exchange, step_ext, topology,
     def _run(state, flag, n):
         return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (state, flag))
 
-    return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
+    return _tracked(_run, runner, donate, nargs=2)
 
 
 def initial_tile_activity(packed: jax.Array, mesh: Mesh, tile_rows: int,
@@ -213,7 +226,8 @@ def make_multi_step_packed_sparse_tiled(
     sharded global tile map from :func:`initial_tile_activity`.
     """
     return _make_tiled_sparse(
-        mesh, rule, topology, _SPEC, tile_rows, tile_words, capacity, donate)
+        mesh, rule, topology, _SPEC, tile_rows, tile_words, capacity, donate,
+        runner="sharded.multi_step_packed_sparse_tiled")
 
 
 def make_multi_step_ltl_pallas(
@@ -264,7 +278,7 @@ def make_multi_step_ltl_pallas(
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_ltl_pallas", donate)
 
 
 def make_multi_step_ltl_planes(
@@ -301,7 +315,7 @@ def make_multi_step_ltl_planes(
     def _run(planes, n):
         return jax.lax.fori_loop(0, n, lambda _, t: generation(t), planes)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_ltl_planes", donate)
 
 
 def make_multi_step_generations_packed_sparse_tiled(
@@ -324,11 +338,13 @@ def make_multi_step_generations_packed_sparse_tiled(
     Returns jitted ``(planes, act, n) -> (planes, act)``."""
     return _make_tiled_sparse(
         mesh, rule, topology, P(None, ROW_AXIS, COL_AXIS),
-        tile_rows, tile_words, capacity, donate)
+        tile_rows, tile_words, capacity, donate,
+        runner="sharded.multi_step_generations_packed_sparse_tiled")
 
 
 def _make_tiled_sparse(mesh, rule, topology, state_spec,
-                       tile_rows, tile_words, capacity, donate):
+                       tile_rows, tile_words, capacity, donate,
+                       runner="sharded.sparse_tiled"):
     """Shared per-tile sharded sparse builder for both layouts: the state
     is (h, w) or (b, h, w) per shard; the activity map is always the 2D
     local tile map. ops.sparse._step_window dispatches the stencil by
@@ -427,7 +443,7 @@ def _make_tiled_sparse(mesh, rule, topology, state_spec,
     def _run(state, act, n):
         return jax.lax.fori_loop(0, n, lambda _, c: gen(*c), (state, act))
 
-    return jax.jit(_run, donate_argnums=(0, 1) if donate else ())
+    return _tracked(_run, runner, donate, nargs=2)
 
 
 def make_multi_step_packed_deep(
@@ -507,7 +523,7 @@ def make_multi_step_packed_deep(
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_packed_deep", donate)
 
 
 def make_multi_step_pallas(
@@ -594,7 +610,7 @@ def make_multi_step_pallas(
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_pallas", donate)
 
 
 def make_multi_step_banded(
@@ -664,7 +680,7 @@ def make_multi_step_banded(
     def _run(state, n):
         return jax.lax.fori_loop(0, n, lambda _, t: generation(t), state)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_banded", donate)
 
 
 def make_multi_step_generations_packed_sparse(
@@ -687,7 +703,8 @@ def make_multi_step_generations_packed_sparse(
         lambda planes, nx, ny: exchange_halo_stack(planes, nx, ny, topology),
         lambda ext: jnp.stack(step_planes_ext(
             [ext[i] for i in range(b)], rule)),
-        topology, donate)
+        topology, donate,
+        runner="sharded.multi_step_generations_packed_sparse")
 
 
 def make_multi_step_generations_pallas(
@@ -739,7 +756,7 @@ def make_multi_step_generations_pallas(
     def _run(planes, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), planes)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_generations_pallas", donate)
 
 
 def make_multi_step_elementary_sharded(
@@ -804,7 +821,7 @@ def make_multi_step_elementary_sharded(
     def _run(tile, chunks):
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_elementary_sharded", donate)
 
 
 def initial_flags(mesh: Mesh) -> jax.Array:
@@ -824,7 +841,8 @@ def make_multi_step_generations(mesh: Mesh, rule, topology: Topology = Topology.
     from ..ops.generations import step_generations_ext
 
     return _make_runner(mesh, rule, topology, step_generations_ext, multi=True,
-                        donate=donate)
+                        donate=donate,
+                        runner="sharded.multi_step_generations")
 
 
 def make_multi_step_ltl_packed(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
@@ -852,7 +870,7 @@ def make_multi_step_ltl_packed(mesh: Mesh, rule, topology: Topology = Topology.T
     def _run(tile, n):
         return jax.lax.fori_loop(0, n, lambda _, t: generation(t), tile)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_ltl_packed", donate)
 
 
 def make_multi_step_generations_packed(
@@ -877,7 +895,7 @@ def make_multi_step_generations_packed(
     def _run(planes, n):
         return jax.lax.fori_loop(0, n, lambda _, t: generation(t), planes)
 
-    return jax.jit(_run, donate_argnums=(0,) if donate else ())
+    return _tracked(_run, "sharded.multi_step_generations_packed", donate)
 
 
 def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
@@ -890,7 +908,7 @@ def make_multi_step_ltl(mesh: Mesh, rule, topology: Topology = Topology.TORUS,
 
     return _make_runner(
         mesh, rule, topology, step_ltl_ext, multi=True, depth=rule.radius,
-        donate=donate,
+        donate=donate, runner="sharded.multi_step_ltl",
     )
 
 
@@ -898,10 +916,10 @@ def make_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
                     donate: bool = False) -> Callable:
     """Jitted sharded step on an unpacked (H, W) uint8 grid (debug path)."""
     return _make_runner(mesh, rule, topology, _dense_ext_step, multi=False,
-                        donate=donate)
+                        donate=donate, runner="sharded.step_dense")
 
 
 def make_multi_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
                           donate: bool = False) -> Callable:
     return _make_runner(mesh, rule, topology, _dense_ext_step, multi=True,
-                        donate=donate)
+                        donate=donate, runner="sharded.multi_step_dense")

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""goltpu-lint CLI: the TPU-invariant static-analysis gate.
+
+    python scripts/lint.py gameoflifewithactors_tpu scripts
+
+Exit codes (the CI contract, pinned in tests/test_lint.py):
+
+    0  clean — no unsuppressed findings
+    1  unsuppressed findings (or stale baseline entries with --strict-baseline)
+    2  bad input — missing path, unparseable file, broken baseline
+
+Runs with **no jax installed** (the engine is pure stdlib AST), so CI
+lints before — and far faster than — the test install. ``--json`` emits
+the machine-readable result for tooling; ``--write-baseline`` refreshes
+the grandfather file (this repo keeps it empty — new findings are fixed
+or pragma'd with a reason, not baselined).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_lib():
+    """Load analysis/lint.py WITHOUT the package __init__ (which imports
+    jax): a synthetic parent package keeps the `from . import rules`
+    registration import working — same standalone idiom as perf_gate.py,
+    so linting works on a jax-less CI box or while a tunnel is wedged."""
+    import importlib.util
+    import types
+
+    pkg_dir = os.path.join(_REPO, "gameoflifewithactors_tpu", "analysis")
+    pkg_name = "goltpu_lint_standalone"
+    if pkg_name not in sys.modules:
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [pkg_dir]
+        sys.modules[pkg_name] = pkg
+    mod_name = pkg_name + ".lint"
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(
+        mod_name, os.path.join(pkg_dir, "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint_lib = _load_lint_lib()
+
+DEFAULT_BASELINE = os.path.join(_REPO, "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="goltpu-lint",
+        description="TPU-invariant static analysis (rules GOL001…GOL006; "
+                    "see README 'Static analysis & sanitizers')")
+    ap.add_argument("paths", nargs="*",
+                    default=["gameoflifewithactors_tpu", "scripts"],
+                    help="files/directories to lint (default: the package "
+                         "and scripts/)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="grandfathered-findings file (default: "
+                         "lint_baseline.json at the repo root when it "
+                         "exists; 'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings into the baseline "
+                         "file and exit 0 (adoption tool — this repo "
+                         "keeps the committed baseline empty)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="stale (unmatched) baseline entries fail the run "
+                         "instead of warning")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    baseline = None
+    if baseline_path and baseline_path != "none":
+        try:
+            baseline = lint_lib.load_baseline(baseline_path)
+        except (OSError, json.JSONDecodeError,
+                lint_lib.BaselineError) as exc:
+            print(f"goltpu-lint: unusable baseline: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    # lint from the repo root so finding paths (and thus baseline keys
+    # and pragma docs) are repo-relative regardless of the caller's cwd
+    paths = []
+    for p in args.paths:
+        if not os.path.isabs(p) and not os.path.exists(p) \
+                and os.path.exists(os.path.join(_REPO, p)):
+            p = os.path.relpath(os.path.join(_REPO, p))
+        paths.append(p)
+
+    result = lint_lib.lint_paths(paths, baseline=baseline)
+
+    if args.write_baseline:
+        payload = lint_lib.baseline_payload(
+            result.findings + result.baselined)
+        out = baseline_path or DEFAULT_BASELINE
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(f"goltpu-lint: wrote {len(payload['findings'])} "
+              f"grandfathered finding(s) to {out}")
+        return 0 if not result.errors else 2
+
+    stale_fails = args.strict_baseline and result.unused_baseline
+    if args.json:
+        doc = result.to_dict()
+        doc["exit_code"] = (2 if result.errors
+                            else 1 if result.findings or stale_fails
+                            else 0)
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in result.findings:
+            print(f.format())
+        for err in result.errors:
+            print(f"goltpu-lint: error: {err}", file=sys.stderr)
+        for e in result.unused_baseline:
+            print(f"goltpu-lint: stale baseline entry (fixed? remove it): "
+                  f"{e.get('path')}: {e.get('code')} {e.get('message')}",
+                  file=sys.stderr)
+        n_files = len([r for r in result.files if r.error is None])
+        summary = (f"goltpu-lint: {n_files} file(s), "
+                   f"{len(result.findings)} finding(s), "
+                   f"{len(result.suppressed)} suppressed by pragma, "
+                   f"{len(result.baselined)} baselined")
+        print(summary, file=sys.stderr)
+    if result.errors:
+        return 2
+    if result.findings or stale_fails:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

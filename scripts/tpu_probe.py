@@ -60,7 +60,7 @@ def probe(timeout: float = 60.0, env: dict | None = None) -> dict:
     ``env`` overrides the child's environment (default: inherit) — tests use
     it to aim the probe at a guaranteed-CPU configuration.
     """
-    t0 = time.time()
+    t0 = time.perf_counter()
     with tempfile.TemporaryFile(mode="w+") as out, tempfile.TemporaryFile(mode="w+") as err:
         p = subprocess.Popen([sys.executable, "-c", _CHILD], stdout=out, stderr=err,
                              env=env)
@@ -81,7 +81,7 @@ def probe(timeout: float = 60.0, env: dict | None = None) -> dict:
         "status": "error",
         "platform": None,
         "n_devices": 0,
-        "elapsed_s": round(time.time() - t0, 2),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
         "stages": stages,
         "detail": "",
     }

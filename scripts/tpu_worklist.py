@@ -319,6 +319,7 @@ def child_ltl_lowering() -> dict:
 
     rule = parse_ltl("bosco")
     g = jnp.asarray(np.zeros((512, 512), dtype=np.uint8))
+    # goltpu: ignore[GOL006] -- introspection-only lower/compile: the HLO text is the product, nothing is dispatched
     txt = (jax.jit(lambda x: step_ltl(x, rule=rule, topology=Topology.TORUS))
            .lower(g).compile().as_text())
     convs = re.findall(r"= *\S+ (?:convolution|conv)\b[^\n]*", txt)
@@ -932,7 +933,7 @@ def main() -> int:
         item = item.strip()
         if item not in ITEMS:
             raise SystemExit(f"unknown item {item!r}; know {sorted(ITEMS)}")
-        t0 = time.time()
+        t0 = time.perf_counter()
         if item in _INPROC_ITEMS:
             try:
                 r = subprocess.run(
@@ -951,7 +952,7 @@ def main() -> int:
             except subprocess.TimeoutExpired:
                 result = {"ok": False,
                           "detail": f"hung >{_watchdog_for(item)}s (wedged?)"}
-        result["elapsed_s"] = round(time.time() - t0, 1)
+        result["elapsed_s"] = round(time.perf_counter() - t0, 1)
         if result.get("ok") and result.get("platform") == "cpu":
             # a --force run on a TPU-less interpreter (or a CPU-fallback
             # jax init) must not merge as captured TPU evidence — the

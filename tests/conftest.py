@@ -53,6 +53,31 @@ def cold_compile_cache(tmp_path, monkeypatch):
     jax.config.update("jax_compilation_cache_dir", saved_dir)
 
 
+# Runtime sanitizers (analysis/sanitizers.py, GOLTPU_SANITIZE=1): run the
+# dense-engine step tests under jax's device→host transfer guard, so a
+# future edit that slips an implicit readback into the step loop fails
+# tier-1 loudly instead of silently serializing TPU pipelines. The
+# engine's sanctioned readbacks (snapshot/population/active_tiles, the
+# sparse step scalar) carry their own allow-scopes — the guard only bites
+# on *undeclared* syncs. Scoped to the dense-engine module: its tests
+# drive every step/observe surface, and test helpers elsewhere do their
+# own ad-hoc host fetches by design.
+_TRANSFER_GUARDED_MODULES = ("tests.test_engine_dense",)
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_transfer_guard(request):
+    from gameoflifewithactors_tpu.analysis import sanitizers
+
+    module = getattr(request, "module", None)
+    if sanitizers.enabled() and \
+            getattr(module, "__name__", "") in _TRANSFER_GUARDED_MODULES:
+        with sanitizers.no_implicit_host_transfers():
+            yield
+    else:
+        yield
+
+
 def pytest_configure(config):
     # the ROADMAP tier-1 command deselects these (-m 'not slow'); register
     # the mark so its use never degrades into an unknown-mark warning

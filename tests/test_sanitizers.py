@@ -1,0 +1,191 @@
+"""Runtime-sanitizer tests (analysis/sanitizers.py): transfer-guard
+scope wiring, the retrace budget over the compile-event log, and the
+engine's GOLTPU_SANITIZE auto-wiring — including the retrace-regression
+test that turns PR 2's warm-start attribution into an enforced
+invariant: *a warm-started engine never pays a real XLA compile again*.
+
+The transfer guard's teeth only bite where a real device→host transfer
+happens (TPU/GPU); on this CPU rig jax performs no guarded transfer, so
+those tests assert the *wiring* (guard config inside the scopes) — the
+same scopes that trip on hardware.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.analysis import sanitizers
+from gameoflifewithactors_tpu.aot import registry as aot_registry
+from gameoflifewithactors_tpu.engine import Engine
+from gameoflifewithactors_tpu.obs import compile as obs_compile
+
+
+def _soup(shape=(64, 64), seed=7):
+    return np.random.default_rng(seed).integers(
+        0, 2, size=shape, dtype=np.uint8)
+
+
+def _fake_event(kind="cache_miss", runner="fake_runner"):
+    t1 = time.perf_counter()
+    return obs_compile.CompileEvent(
+        runner=runner, signature="u32[2,2]", wall_seconds=1.25,
+        cache_miss=(kind == "cache_miss"), donated=False,
+        t0=t1 - 1.25, t1=t1, kind=kind)
+
+
+# -- enabled() gating ---------------------------------------------------------
+
+
+def test_enabled_reads_env_per_call(monkeypatch):
+    monkeypatch.delenv(sanitizers.ENV_SANITIZE, raising=False)
+    assert not sanitizers.enabled()
+    for on in ("1", "true", "ON", "yes"):
+        monkeypatch.setenv(sanitizers.ENV_SANITIZE, on)
+        assert sanitizers.enabled()
+    monkeypatch.setenv(sanitizers.ENV_SANITIZE, "0")
+    assert not sanitizers.enabled()
+
+
+# -- transfer-guard scopes ----------------------------------------------------
+
+
+def test_transfer_guard_scopes_set_jax_config(monkeypatch):
+    import jax
+
+    monkeypatch.setenv(sanitizers.ENV_SANITIZE, "1")
+    assert jax.config.jax_transfer_guard_device_to_host in (None, "allow")
+    with sanitizers.no_implicit_host_transfers():
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+        # a sanctioned readback re-opens the gate inside the guard
+        with sanitizers.allow_host_transfers("declared readback"):
+            assert jax.config.jax_transfer_guard_device_to_host == "allow"
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+
+
+def test_transfer_guard_scopes_are_noops_when_disabled(monkeypatch):
+    import jax
+
+    monkeypatch.delenv(sanitizers.ENV_SANITIZE, raising=False)
+    with sanitizers.no_implicit_host_transfers():
+        assert jax.config.jax_transfer_guard_device_to_host is None
+
+
+def test_allow_scope_requires_a_reason():
+    with pytest.raises(ValueError):
+        with sanitizers.allow_host_transfers(""):
+            pass
+
+
+def test_engine_observe_surfaces_work_under_the_guard(monkeypatch):
+    """snapshot/population/active_tiles carry their own allow-scopes, so
+    the dense-engine tests (conftest wires this module pattern) keep
+    working with the step loop guarded."""
+    monkeypatch.setenv(sanitizers.ENV_SANITIZE, "1")
+    eng = Engine(_soup(), "B3/S23", backend="packed")
+    with sanitizers.no_implicit_host_transfers():
+        eng.step(4)
+        eng.block_until_ready()
+        assert eng.snapshot().shape == (64, 64)
+        assert eng.population() >= 0
+        assert eng.active_tiles() is None
+
+
+# -- retrace budget -----------------------------------------------------------
+
+
+def test_retrace_budget_passes_on_hits_and_fails_on_misses():
+    log = obs_compile.CompileEventLog()
+    with sanitizers.retrace_budget(log=log) as sentinel:
+        log.record(_fake_event("cache_hit"))
+        log.record(_fake_event("aot_loaded"))
+        assert sentinel.misses() == []
+    with pytest.raises(sanitizers.RetraceError) as ei:
+        with sanitizers.retrace_budget(log=log, context="unit"):
+            log.record(_fake_event("cache_miss"))
+    assert "fake_runner" in str(ei.value) and "unit" in str(ei.value)
+
+
+def test_retrace_budget_allows_n_compiles():
+    log = obs_compile.CompileEventLog()
+    with sanitizers.retrace_budget(budget=2, log=log):
+        log.record(_fake_event())
+        log.record(_fake_event())
+
+
+def test_retrace_budget_detaches_its_listener():
+    log = obs_compile.CompileEventLog()
+    with sanitizers.retrace_budget(log=log) as sentinel:
+        pass
+    log.record(_fake_event())
+    assert sentinel.misses() == []  # disarmed: later misses are not ours
+
+
+def test_retrace_budget_does_not_mask_body_exceptions():
+    log = obs_compile.CompileEventLog()
+    with pytest.raises(KeyError):
+        with sanitizers.retrace_budget(log=log):
+            log.record(_fake_event())
+            raise KeyError("body failure wins over the budget check")
+
+
+# -- the enforced warm-start invariant (satellite: retrace regression) --------
+
+
+def test_warm_started_engine_steps_with_zero_cache_miss(cold_compile_cache):
+    """PR 2 measured that a warm-started engine pays ~zero compile; this
+    pins it as an *invariant*: warm the AOT/warm-start path, step, and
+    assert zero ``cache_miss`` compile events via the CompileEventLog."""
+    grid = _soup()
+    cold = Engine(grid, "B3/S23", backend="packed")
+    cold.step(2)
+    cold.block_until_ready()
+    aot_registry.serialize_engine(cold)
+
+    warm = Engine(grid, "B3/S23", backend="packed")
+    assert warm.aot_loaded, "the second engine must take the AOT path"
+    before = len(obs_compile.COMPILE_LOG.events())
+    with sanitizers.retrace_budget(context="warm-started engine"):
+        warm.step(8)
+        warm.block_until_ready()
+        assert warm.population() >= 0
+    after = obs_compile.COMPILE_LOG.events()[before:]
+    assert [e for e in after if e.cache_miss] == [], \
+        "a warmed engine recompiled — warm-start attribution regressed"
+
+
+def test_engine_auto_arms_retrace_sentinel_under_sanitize(
+        cold_compile_cache, monkeypatch):
+    """GOLTPU_SANITIZE=1 + a warm-started engine = armed sentinel; a real
+    compile landing after warm fails the very next step()."""
+    monkeypatch.setenv(sanitizers.ENV_SANITIZE, "1")
+    grid = _soup()
+    cold = Engine(grid, "B3/S23", backend="packed")
+    assert cold._retrace_sentinel is None  # cold engines may compile
+    cold.step(1)
+    cold.block_until_ready()
+    aot_registry.serialize_engine(cold)
+
+    warm = Engine(grid, "B3/S23", backend="packed")
+    try:
+        assert warm.aot_loaded and warm._retrace_sentinel is not None
+        warm.step(2)  # clean: the AOT runner never re-traces
+        obs_compile.COMPILE_LOG.record(_fake_event())  # simulated retrace
+        with pytest.raises(sanitizers.RetraceError):
+            warm.step(1)
+    finally:
+        warm._retrace_sentinel.disarm()  # never leak the listener
+
+
+def test_engine_sentinel_absent_when_not_sanitizing(cold_compile_cache,
+                                                    monkeypatch):
+    monkeypatch.delenv(sanitizers.ENV_SANITIZE, raising=False)
+    grid = _soup()
+    cold = Engine(grid, "B3/S23", backend="packed")
+    cold.step(1)
+    cold.block_until_ready()
+    aot_registry.serialize_engine(cold)
+    warm = Engine(grid, "B3/S23", backend="packed")
+    assert warm.aot_loaded and warm._retrace_sentinel is None
