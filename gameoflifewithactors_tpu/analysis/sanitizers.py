@@ -121,6 +121,13 @@ class RetraceSentinel:
         with self._lock:
             return list(self._events)
 
+    def reset(self) -> None:
+        """Forget taped misses without disarming — supervised recovery
+        attributes a *planned* retrace fault to its injection and re-arms
+        the budget for the remainder of the run."""
+        with self._lock:
+            self._events.clear()
+
     def check(self) -> None:
         misses = self.misses()
         if len(misses) > self.budget:

@@ -36,6 +36,7 @@ def _run_elementary(cfg, args, rule) -> int:
     # the requested side effect (a later --resume on the missing file
     # would fail far from the cause)
     for flag, value in (("--checkpoint", cfg.checkpoint),
+                        ("--supervise", cfg.supervise or None),
                         ("--metrics", cfg.metrics), ("--mesh", cfg.mesh),
                         ("--ppm-every", cfg.ppm_every or None),
                         ("--save-rle", cfg.save_rle),
@@ -312,7 +313,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # Pacing (rate limit / periodic metrics / live frames) needs the tick
     # loop; otherwise the whole run is one device dispatch.
     needs_pacing = args.render == "live" or cfg.rate_hz or cfg.metrics
-    if needs_pacing:
+    if cfg.supervise:
+        if not cfg.checkpoint:
+            raise SystemExit(
+                "--supervise needs --checkpoint PATH: the restart policy "
+                "restores from the checkpoint it maintains there")
+        if needs_pacing:
+            raise SystemExit(
+                "--supervise owns the tick loop; it is incompatible with "
+                "--render live, --rate, and --metrics pacing (run the "
+                "supervised process under --serve-metrics instead)")
+        from .resilience import RestartPolicy, Supervisor
+
+        supervisor = Supervisor(
+            coordinator, checkpoint_path=cfg.checkpoint,
+            checkpoint_every=cfg.checkpoint_every,
+            policy=RestartPolicy(max_restarts=cfg.max_restarts))
+        stats = supervisor.run(cfg.steps)
+        if stats["restarts"]:
+            print(f"supervisor: recovered from {stats['restarts']} "
+                  f"failure(s) {stats['restarts_by_cause']}",
+                  file=sys.stderr)
+    elif needs_pacing:
         scheduler.run(max_generations=cfg.steps)
     elif seq is not None:
         # surface a frame to the sequence every N generations

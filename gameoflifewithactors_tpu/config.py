@@ -43,6 +43,9 @@ class SimulationConfig:
     track_population: bool = False
     checkpoint: Optional[str] = None        # save path (written at end)
     resume: Optional[str] = None            # checkpoint to resume from
+    supervise: bool = False                 # restart-with-rollback loop (resilience/)
+    checkpoint_every: int = 100             # supervised: auto-checkpoint cadence
+    max_restarts: int = 5                   # supervised: circuit-breaker threshold
     ppm: Optional[str] = None               # final-frame / spacetime PPM path
     ppm_every: int = 0                      # full-res frame sequence cadence
     save_rle: Optional[str] = None          # final state as RLE (binary rules)
@@ -191,7 +194,7 @@ def make_parser() -> argparse.ArgumentParser:
                         "G generations instead of 1-deep every generation "
                         "(communication-avoiding; bit-exact for G <= 32)")
     p.add_argument("--sparse-tile", type=_parse_geometry, default=None, metavar="RxC",
-                   help="sparse backend tile size in cells; C % 32 == 0 "
+                   help="sparse backend tile size in cells; C %% 32 == 0 "
                         "(default: auto-scaled so the activity map stays small; "
                         "32x128 for small grids)")
     p.add_argument("--sparse-capacity", type=int, default=None, metavar="N",
@@ -213,6 +216,20 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--population", action="store_true", help="track live-cell count")
     p.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="write final state here")
+    p.add_argument("--supervise", action="store_true",
+                   help="run under a restart supervisor (resilience/): "
+                        "auto-checkpoint to --checkpoint every "
+                        "--checkpoint-every generations and, on a "
+                        "coordinator exception or watchdog stall, restore "
+                        "the last checkpoint and replay with capped "
+                        "exponential backoff (see README 'Resilience & "
+                        "soak'). Requires --checkpoint PATH")
+    p.add_argument("--checkpoint-every", type=int, default=100, metavar="N",
+                   help="with --supervise: checkpoint cadence in "
+                        "generations (default 100)")
+    p.add_argument("--max-restarts", type=int, default=5, metavar="N",
+                   help="with --supervise: consecutive failed chunks "
+                        "before the circuit breaker gives up (default 5)")
     p.add_argument("--ppm", default=None, metavar="PATH",
                    help="write the final grid (2D rules) or the full "
                         "spacetime diagram (1D W-rules) as a PPM image")
@@ -296,6 +313,9 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         track_population=args.population,
         checkpoint=args.checkpoint,
         resume=args.resume,
+        supervise=args.supervise,
+        checkpoint_every=args.checkpoint_every,
+        max_restarts=args.max_restarts,
         ppm=args.ppm,
         ppm_every=args.ppm_every,
         save_rle=args.save_rle,

@@ -109,9 +109,14 @@ class GridCoordinator:
         if random_fill is not None:
             if seed is not None:
                 raise ValueError("give either `seed` or `random_fill`, not both")
-            return np.asarray(
-                seeds_lib.bernoulli(jax.random.key(rng_seed), shape, random_fill)
-            )
+            # copy while `filled` is still referenced: np.asarray of a CPU
+            # jax.Array is a zero-copy view, and once the device array is
+            # collected the view dangles — the engine would be seeded from
+            # freed memory (nondeterministic grids, heap corruption under
+            # the 8-fake-device test config)
+            filled = seeds_lib.bernoulli(jax.random.key(rng_seed), shape,
+                                         random_fill)
+            return np.array(filled, copy=True)
         if seed is None:
             return seeds_lib.empty(shape)
         if isinstance(seed, str):
@@ -215,6 +220,12 @@ class GridCoordinator:
                 done += chunk
         else:
             self.tick(generations)
+
+    def notify_now(self) -> None:
+        """Surface the current state to subscribers outside a tick — the
+        supervisor calls this after a checkpoint restore so renderers see
+        the rolled-back generation instead of a silent jump."""
+        self._notify()
 
     def snapshot(self) -> np.ndarray:
         return self.engine.snapshot()

@@ -173,7 +173,11 @@ class Engine:
         self.gens_per_exchange = gens_per_exchange
         np_grid = np.asarray(grid, dtype=np.uint8)
         self._validate_states(np_grid)
-        grid = jnp.asarray(np_grid)
+        # copy=True: the CPU backend zero-copies host numpy buffers, and
+        # the donated step chain then writes through the caller's memory
+        # for the engine's whole lifetime — freed-seed heap corruption the
+        # moment the caller drops their array (resilience soak found this)
+        grid = jnp.array(np_grid, copy=True)
         if grid.ndim != 2:
             raise ValueError(f"grid must be 2D, got shape {grid.shape}")
         self.shape: Tuple[int, int] = tuple(grid.shape)
@@ -798,7 +802,12 @@ class Engine:
                 dense = bitpack.unpack(self.state) if self._packed else self.state
             if max_shape is not None:
                 dense = _downsample_max(dense, max_shape)
-            return np.asarray(dense)
+            # copy while `dense` is still referenced: np.asarray of a CPU
+            # jax.Array is a zero-copy view, and this buffer is either the
+            # live state (donated to the next step) or a temporary about to
+            # be collected — a view would dangle, and "stable host copy" is
+            # this method's contract (see the `state` docstring)
+            return np.array(dense, dtype=np.uint8, copy=True)
 
     def halo_bytes_per_gen(self, source: str = "auto") -> int:
         """Interconnect (ICI/DCN) bytes one generation moves: the ppermute
@@ -1037,7 +1046,10 @@ class Engine:
     def set_grid(self, grid, generation: Optional[int] = None) -> None:
         np_grid = np.asarray(grid, dtype=np.uint8)
         self._validate_states(np_grid)
-        grid = jnp.asarray(np_grid)
+        # copy=True: same freed-seed hazard as __init__ — the restored
+        # state must not alias the caller's host buffer (donation writes
+        # through it for the rest of the run)
+        grid = jnp.array(np_grid, copy=True)
         if tuple(grid.shape) != self.shape:
             raise ValueError(f"grid shape {grid.shape} != engine shape {self.shape}")
         if self._gen_packed:

@@ -22,7 +22,7 @@ import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from .registry import REGISTRY, MetricsRegistry
 
@@ -106,15 +106,20 @@ class MetricsServer:
     ``MetricsServer(port).start()`` binds immediately (port 0 picks an
     ephemeral port — read it back from ``.port``); ``stop()`` shuts the
     thread down. ``/metrics`` renders the registry live per scrape;
-    ``/healthz`` answers 200 with a one-line JSON heartbeat. Also a
-    context manager."""
+    ``/healthz`` answers 200 with a one-line JSON heartbeat, merged with
+    whatever ``health_info()`` returns — the soak driver's liveness +
+    progress probe (a worker reports its generation/restart counts
+    there, cheaper than parsing the full exposition). Also a context
+    manager."""
 
     def __init__(self, port: int = 0, *,
                  registry: MetricsRegistry = REGISTRY,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0",
+                 health_info: Optional[Callable[[], dict]] = None):
         self.requested_port = int(port)
         self.host = host
         self.registry = registry
+        self._health_info = health_info
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -126,6 +131,7 @@ class MetricsServer:
         if self._httpd is not None:
             return self
         registry = self.registry
+        health_info = self._health_info
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -135,7 +141,15 @@ class MetricsServer:
                                              ).encode("utf-8")
                     ctype = CONTENT_TYPE
                 elif path == "/healthz":
-                    body = (json.dumps({"ok": True}) + "\n").encode("utf-8")
+                    payload = {"ok": True}
+                    if health_info is not None:
+                        try:
+                            payload.update(health_info() or {})
+                        except Exception:
+                            # a broken info hook must not take the
+                            # liveness probe down with it
+                            payload["info_error"] = True
+                    body = (json.dumps(payload) + "\n").encode("utf-8")
                     ctype = "application/json"
                 else:
                     self.send_error(404, "try /metrics")
@@ -175,6 +189,9 @@ class MetricsServer:
 
 
 def serve_metrics(port: int, *, registry: MetricsRegistry = REGISTRY,
-                  host: str = "0.0.0.0") -> MetricsServer:
+                  host: str = "0.0.0.0",
+                  health_info: Optional[Callable[[], dict]] = None,
+                  ) -> MetricsServer:
     """Start and return a :class:`MetricsServer` (CLI convenience)."""
-    return MetricsServer(port, registry=registry, host=host).start()
+    return MetricsServer(port, registry=registry, host=host,
+                         health_info=health_info).start()

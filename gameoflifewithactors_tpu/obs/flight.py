@@ -56,6 +56,7 @@ class FlightRecorder:
         self._steps: Deque[dict] = collections.deque(maxlen=max_records)
         self._spans: Deque[dict] = collections.deque(maxlen=max_records)
         self._compiles: Deque[dict] = collections.deque(maxlen=max_records)
+        self._notes: Deque[dict] = collections.deque(maxlen=max_records)
         self._stalls: List[dict] = []
         self._lock = threading.Lock()
         self._installed = False
@@ -85,6 +86,18 @@ class FlightRecorder:
         with self._lock:
             self._stalls.append(ev if isinstance(ev, dict) else ev.to_dict())
         self.dump(f"watchdog stall: {getattr(ev, 'label', '?')}")
+
+    def note(self, kind: str, payload: Optional[dict] = None) -> None:
+        """Tape a free-form event (fault injections, supervisor restarts —
+        the resilience layer's breadcrumbs). Rides the same ring buffer
+        discipline as the telemetry tapes and lands in every dump, so a
+        crash report shows *what was done to* the run, not only what the
+        run measured."""
+        rec = {"kind": kind, "t": time.perf_counter()}
+        if payload:
+            rec.update(payload)
+        with self._lock:
+            self._notes.append(rec)
 
     # -- wiring --------------------------------------------------------------
 
@@ -164,6 +177,7 @@ class FlightRecorder:
             steps = list(self._steps)
             spans = list(self._spans)
             compiles = list(self._compiles)
+            notes = list(self._notes)
             stalls = list(self._stalls)
         last = self._tracer.last_completed()
         header = {
@@ -176,7 +190,7 @@ class FlightRecorder:
             "open_spans": self._tracer.current_stack(),
             "counts": {"step_metrics": len(steps), "spans": len(spans),
                        "compile_events": len(compiles),
-                       "stalls": len(stalls)},
+                       "events": len(notes), "stalls": len(stalls)},
         }
         if extra:
             header.update(extra)
@@ -189,6 +203,7 @@ class FlightRecorder:
                 for kind, records in (("step_metrics", steps),
                                       ("span", spans),
                                       ("compile_event", compiles),
+                                      ("event", notes),
                                       ("stall", stalls)):
                     for rec in records:
                         f.write(json.dumps({"type": kind, **rec}) + "\n")
@@ -210,10 +225,11 @@ class FlightRecorder:
 
 def load_dump(path: str) -> dict:
     """Parse a dump back into {"flight": header, "step_metrics": [...],
-    "span": [...], "compile_event": [...], "stall": [...], "registry":
-    snapshot} — the reader tests and post-mortem tooling use."""
+    "span": [...], "compile_event": [...], "event": [...], "stall":
+    [...], "registry": snapshot} — the reader tests and post-mortem
+    tooling use."""
     out: dict = {"step_metrics": [], "span": [], "compile_event": [],
-                 "stall": []}
+                 "event": [], "stall": []}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -251,3 +267,12 @@ def disarm() -> None:
 
 def active_flight_recorder() -> Optional[FlightRecorder]:
     return _ACTIVE
+
+
+def note_event(kind: str, payload: Optional[dict] = None) -> None:
+    """Tape an event onto the armed recorder; silently a no-op when none
+    is armed — call sites (fault injectors, the supervisor) must not
+    need to know whether a flight recorder exists."""
+    fr = _ACTIVE
+    if fr is not None:
+        fr.note(kind, payload)

@@ -149,7 +149,8 @@ class StallWatchdog:
             deadline_seconds=self.deadline,
             last_completed_span=last.name if last else None,
             open_spans=tuple(stack), t=now)
-        self.events.append(ev)
+        with self._lock:
+            self.events.append(ev)
         REGISTRY.counter("stalls", "ticks that overran the watchdog deadline"
                          ).inc(label=label)
         for sink in sinks:
@@ -158,6 +159,14 @@ class StallWatchdog:
             except Exception:
                 pass  # a broken sink must not kill the monitor thread
         return ev
+
+    def events_since(self, n: int) -> List[StallEvent]:
+        """Stall events recorded after index ``n`` — the supervisor's
+        poll: snapshot ``len(wd.events)`` before a tick, read the tail
+        after it, and any entries are the stalls that tick suffered.
+        Under the lock: the monitor thread appends concurrently."""
+        with self._lock:
+            return list(self.events[n:])
 
     def add_on_stall(self, fn: Callable[[StallEvent], None]) -> None:
         """Chain an extra stall sink after ``on_stall`` (the flight

@@ -1,0 +1,27 @@
+"""Resilience layer: supervision, deterministic fault plans, soak workers.
+
+The reference framework's claim is that supervision keeps the grid
+alive through misbehaving actors; this package is that claim made
+checkable (ROADMAP item 5). :class:`Supervisor` wraps a
+GridCoordinator with checkpoint-restore restart semantics (bit-exact,
+unlike Akka's state-losing restart), :class:`FaultPlan` makes fault
+campaigns seeded and replayable, and ``worker.py`` is the subprocess
+body the fleet driver (``scripts/soak.py``) launches, kills, and
+resumes.
+"""
+
+from .faultplan import (ALL_KINDS, FaultEvent, FaultPlan, apply_fault,
+                        induce_retrace, induce_stall)
+from .supervisor import CircuitOpenError, RestartPolicy, Supervisor
+
+__all__ = [
+    "ALL_KINDS",
+    "CircuitOpenError",
+    "FaultEvent",
+    "FaultPlan",
+    "RestartPolicy",
+    "Supervisor",
+    "apply_fault",
+    "induce_retrace",
+    "induce_stall",
+]

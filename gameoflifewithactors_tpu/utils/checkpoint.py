@@ -10,7 +10,9 @@ from (sharding is an execution detail, not simulation state).
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -36,6 +38,12 @@ def save(engine: Engine, path: "str | Path") -> Path:
     (device-side unpack + host gather, which is what snapshot() costs).
     Byte-layout engines keep the v1 (packbits) / v2 (multistate cells)
     forms. All versions reload onto any mesh/backend.
+
+    Crash-safe: the bytes land in a temp file in the same directory and
+    are ``os.replace``d into place, so a SIGKILL mid-save (the soak
+    harness does exactly this) can never leave a truncated NPZ where a
+    loadable checkpoint used to be — the previous checkpoint survives
+    until the new one is durably whole.
     """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -45,29 +53,39 @@ def save(engine: Engine, path: "str | Path") -> Path:
         generation=engine.generation,
         shape=list(engine.shape),
     )
-    with open(path, "wb") as f:
-        if engine._packed:
-            meta = dict(version=FORMAT_VERSION, layout="packed32",
-                        multistate=False, **base)
-            np.savez_compressed(
-                f, words=np.asarray(engine.state), meta=json.dumps(meta))
-        elif getattr(engine, "_gen_packed", False):
-            meta = dict(version=FORMAT_VERSION, layout="genplanes32",
-                        multistate=True, **base)
-            np.savez_compressed(
-                f, planes=np.asarray(engine.state), meta=json.dumps(meta))
-        else:
-            grid = engine.snapshot()
-            multistate = bool(grid.max(initial=0) > 1)  # Generations states
-            # byte-layout files keep their historical stamps (v1 binary
-            # packbits / v2 multistate cells) so old readers still load them
-            meta = dict(version=2 if multistate else 1,
-                        multistate=multistate, **base)
-            if multistate:
-                np.savez_compressed(f, cells=grid, meta=json.dumps(meta))
+    # pid-qualified temp name: two processes checkpointing to the same
+    # path (supervisor + an operator's manual save) must not interleave
+    tmp = path.with_name(f"{path.name}.tmp{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            if engine._packed:
+                meta = dict(version=FORMAT_VERSION, layout="packed32",
+                            multistate=False, **base)
+                np.savez_compressed(
+                    f, words=np.asarray(engine.state), meta=json.dumps(meta))
+            elif getattr(engine, "_gen_packed", False):
+                meta = dict(version=FORMAT_VERSION, layout="genplanes32",
+                            multistate=True, **base)
+                np.savez_compressed(
+                    f, planes=np.asarray(engine.state), meta=json.dumps(meta))
             else:
-                np.savez_compressed(f, bits=np.packbits(grid, axis=1),
-                                    meta=json.dumps(meta))
+                grid = engine.snapshot()
+                multistate = bool(grid.max(initial=0) > 1)  # Generations states
+                # byte-layout files keep their historical stamps (v1 binary
+                # packbits / v2 multistate cells) so old readers still load
+                # them
+                meta = dict(version=2 if multistate else 1,
+                            multistate=multistate, **base)
+                if multistate:
+                    np.savez_compressed(f, cells=grid, meta=json.dumps(meta))
+                else:
+                    np.savez_compressed(f, bits=np.packbits(grid, axis=1),
+                                        meta=json.dumps(meta))
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
     return path
 
 

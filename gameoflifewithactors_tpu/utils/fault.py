@@ -19,9 +19,21 @@ import jax
 import numpy as np
 
 from ..engine import Engine
+from ..obs import flight as obs_flight
+from ..obs.registry import REGISTRY
 from . import checkpoint as ckpt_lib
 
 Validator = Callable[[Engine], bool]
+
+
+def _record_injection(kind: str, **detail) -> None:
+    """Every induced fault shows up in /metrics
+    (``goltpu_faults_injected_total{kind=...}``) and on the flight tape —
+    a crash dump that doesn't say "someone corrupted the grid at t-2s"
+    sends the post-mortem chasing a phantom engine bug."""
+    REGISTRY.counter("faults_injected_total",
+                     "induced faults, by injector kind").inc(kind=kind)
+    obs_flight.note_event("fault_injected", {"fault": kind, **detail})
 
 
 # -- injectors (test hooks) --------------------------------------------------
@@ -32,6 +44,8 @@ def corrupt_region(engine: Engine, top: int, left: int, h: int, w: int, seed: in
     rng = np.random.default_rng(seed)
     grid[top : top + h, left : left + w] = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
     engine.set_grid(grid)
+    _record_injection("corrupt_region", top=top, left=left, h=h, w=w,
+                      at_gen=engine.generation)
 
 
 def drop_region(engine: Engine, top: int, left: int, h: int, w: int) -> None:
@@ -40,6 +54,8 @@ def drop_region(engine: Engine, top: int, left: int, h: int, w: int) -> None:
     grid = engine.snapshot().copy()
     grid[top : top + h, left : left + w] = 0
     engine.set_grid(grid)
+    _record_injection("drop_region", top=top, left=left, h=h, w=w,
+                      at_gen=engine.generation)
 
 
 def _rewrite_shard(engine: Engine, shard_index: int, fn) -> None:
@@ -83,6 +99,8 @@ def drop_shard(engine: Engine, shard_index: int) -> None:
     fault. All-dead is a valid state in every grid representation, so this
     works on packed, dense, and bit-plane engines alike."""
     _rewrite_shard(engine, shard_index, np.zeros_like)
+    _record_injection("drop_shard", shard=shard_index,
+                      at_gen=engine.generation)
 
 
 def corrupt_shard(engine: Engine, shard_index: int, seed: int = 0) -> None:
@@ -101,6 +119,8 @@ def corrupt_shard(engine: Engine, shard_index: int, seed: int = 0) -> None:
         return rng.integers(0, 2 ** 32, size=data.shape, dtype=np.uint32)
 
     _rewrite_shard(engine, shard_index, scramble)
+    _record_injection("corrupt_shard", shard=shard_index,
+                      at_gen=engine.generation)
 
 
 # -- validators --------------------------------------------------------------
@@ -157,6 +177,8 @@ class GuardedRun:
         grid, meta = ckpt_lib.load_grid(self.checkpoint_path)
         self.engine.set_grid(grid, generation=meta["generation"])
         self.recoveries += 1
+        obs_flight.note_event("guard_restore",
+                              {"to_gen": self.engine.generation})
         if self.on_recover is not None:
             self.on_recover(self.engine.generation)
 
@@ -176,6 +198,14 @@ class GuardedRun:
                 ckpt_lib.save(self.engine, self.checkpoint_path)
                 retries = 0
             else:
+                if last_exc is None:
+                    REGISTRY.counter(
+                        "validator_trips_total",
+                        "state-validator rejections (guard + supervisor)"
+                    ).inc(where="guard")
+                    obs_flight.note_event(
+                        "validator_trip",
+                        {"where": "guard", "at_gen": self.engine.generation})
                 if retries >= self.max_retries:
                     raise RuntimeError(
                         f"state validation failed {retries + 1}x in a row at "

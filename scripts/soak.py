@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Fleet soak: N supervised coordinator processes under a seeded fault plan.
+
+    python scripts/soak.py --seed 0 --duration 60
+
+Launches a mixed fleet of worker subprocesses (resilience/worker.py —
+packed, dense, sparse, LtL, ensemble specs), an unfaulted *oracle*
+twin for each, and executes a deterministic
+:class:`~gameoflifewithactors_tpu.resilience.FaultPlan`: state
+corruption and drops through the supervisor's detected-fault channel,
+induced stalls and retraces, and driver-side SIGKILL + ``--resume`` of
+live workers. Throughout the run it scrapes each worker's ``/healthz``
+(progress, restart counts) and ``/metrics`` (obs/exporter.py).
+
+At the end it asserts the invariants the obs + resilience stack
+promises, and writes ``soak_report.json``:
+
+- every worker (faulted and oracle) exits 0 with ``ok: true`` — no
+  circuit opened, no unexplained post-warm retrace (RetraceSentinel),
+  no sanitizer trip (workers run under ``GOLTPU_SANITIZE=1``);
+- the fleet injected the required fault-kind floor (state corruption,
+  induced stall, worker SIGKILL);
+- each faulted worker's final grid is bit-identical to its oracle's —
+  recovery is exact, not approximate;
+- every induced stall was detected by the watchdog and left a flight
+  dump on disk;
+- every killed worker resumed from its atomic checkpoint and still
+  converged to the oracle grid;
+- ``/metrics`` answered with ``goltpu_``-namespace content for every
+  worker.
+
+Exit 0 = all green. Same ``--seed`` replays the identical fault
+schedule (the report embeds the plan JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from gameoflifewithactors_tpu.resilience.faultplan import (  # noqa: E402
+    STATE_KINDS, FaultPlan)
+
+FLAVOR_ORDER = ("packed", "dense", "sparse", "ltl", "ensemble")
+SHAPES = {"packed": (128, 128), "dense": (128, 128), "sparse": (128, 128),
+          "ltl": (96, 96), "ensemble": (64, 64)}
+
+
+def build_specs(args, out: Path, plan: FaultPlan) -> List[dict]:
+    specs = []
+    for w in range(args.processes):
+        flavor = FLAVOR_ORDER[w % len(FLAVOR_ORDER)]
+        specs.append({
+            "name": f"w{w}-{flavor}",
+            "flavor": flavor,
+            "shape": list(SHAPES[flavor]),
+            "rng_seed": args.seed * 1000 + w,
+            "random_fill": 0.33,
+            "generations": args.generations,
+            "checkpoint_every": args.checkpoint_every,
+            "watchdog_deadline": args.watchdog_deadline,
+            "chunk_sleep_seconds": args.chunk_sleep,
+            "workdir": str(out / f"w{w}"),
+            "events": [e.to_dict()
+                       for e in plan.for_worker(w) if e.kind != "kill"],
+        })
+    return specs
+
+
+class WorkerProc:
+    """One worker subprocess + its scrape state."""
+
+    def __init__(self, spec_path: Path, workdir: Path, env: dict,
+                 resume: bool = False):
+        self.workdir = workdir
+        self.log = open(workdir / "worker.log", "ab")
+        cmd = [sys.executable, "-m",
+               "gameoflifewithactors_tpu.resilience.worker",
+               "--spec", str(spec_path)]
+        if resume:
+            cmd.append("--resume")
+        self.proc = subprocess.Popen(cmd, cwd=_REPO, env=env,
+                                     stdout=subprocess.PIPE,
+                                     stderr=self.log, text=True)
+        self.port: Optional[int] = None
+        self.resumed = resume
+        self.last_health: dict = {}
+        self.last_metrics: str = ""
+
+    def read_port(self, timeout_s: float = 120.0) -> int:
+        """First stdout line is ``METRICS_PORT <port>`` (printed before
+        any stepping, but after the jax import the subprocess pays)."""
+        t0 = time.perf_counter()
+        line = self.proc.stdout.readline()
+        if not line.startswith("METRICS_PORT"):
+            raise RuntimeError(
+                f"worker announced {line!r} instead of METRICS_PORT "
+                f"(after {time.perf_counter() - t0:.0f}s)")
+        self.port = int(line.split()[1])
+        return self.port
+
+    def scrape(self) -> None:
+        if self.port is None or self.proc.poll() is not None:
+            return
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/healthz",
+                    timeout=2) as r:
+                self.last_health = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/metrics",
+                    timeout=2) as r:
+                self.last_metrics = r.read().decode("utf-8")
+        except (OSError, ValueError):
+            pass  # mid-restart or mid-kill; the next poll retries
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.log.close()
+
+
+def run_fleet(args, out: Path, specs: List[dict], plan: FaultPlan,
+              env: dict) -> dict:
+    """Launch faulted workers + oracles, execute kills, wait, report."""
+    deadline = time.perf_counter() + args.duration + args.grace
+    kills = {e.worker: e for e in plan.events if e.kind == "kill"
+             if specs[e.worker]["flavor"] != "ensemble"}
+    killed: dict = {}
+
+    workers: List[WorkerProc] = []
+    oracles: List[WorkerProc] = []
+    for spec in specs:
+        wd = Path(spec["workdir"])
+        wd.mkdir(parents=True, exist_ok=True)
+        spec_path = wd / "spec.json"
+        spec_path.write_text(json.dumps(spec, indent=2))
+        workers.append(WorkerProc(spec_path, wd, env))
+        # the oracle twin: identical spec, zero faults, no pacing
+        ospec = dict(spec, events=[], chunk_sleep_seconds=0.0,
+                     name=spec["name"] + "-oracle",
+                     workdir=str(out / f"oracle-{spec['name']}"))
+        owd = Path(ospec["workdir"])
+        owd.mkdir(parents=True, exist_ok=True)
+        ospec_path = owd / "spec.json"
+        ospec_path.write_text(json.dumps(ospec, indent=2))
+        oracles.append(WorkerProc(ospec_path, owd, env))
+    for p in workers + oracles:
+        p.read_port()
+
+    # poll loop: scrape, time kills, resume killed workers
+    while time.perf_counter() < deadline:
+        alive = [p for p in workers + oracles if p.proc.poll() is None]
+        if not alive:
+            break
+        for i, p in enumerate(workers):
+            p.scrape()
+            ev = kills.get(i)
+            if (ev is not None and i not in killed
+                    and p.proc.poll() is None
+                    and p.last_health.get("generation", 0) >= ev.at_gen):
+                os.kill(p.proc.pid, signal.SIGKILL)
+                p.proc.wait()
+                killed[i] = {"worker": i, "scheduled_at_gen": ev.at_gen,
+                             "killed_at_gen": p.last_health["generation"]}
+                print(f"soak: SIGKILL w{i} at generation "
+                      f"{p.last_health['generation']} (scheduled "
+                      f">= {ev.at_gen}); resuming", flush=True)
+                old = p
+                workers[i] = WorkerProc(
+                    Path(specs[i]["workdir"]) / "spec.json",
+                    Path(specs[i]["workdir"]), env, resume=True)
+                workers[i].read_port()
+                old.log.close()
+        for p in oracles:
+            p.scrape()
+        time.sleep(args.poll_seconds)
+
+    results = {"workers": [], "oracles": [], "killed": list(killed.values())}
+    for kind, procs in (("workers", workers), ("oracles", oracles)):
+        for p in procs:
+            rc = p.proc.poll()
+            if rc is None:
+                p.proc.kill()
+                rc = "timeout"
+            report_path = p.workdir / "report.json"
+            report = (json.loads(report_path.read_text())
+                      if report_path.exists() else None)
+            results[kind].append({
+                "workdir": str(p.workdir), "exit_code": rc,
+                "report": report, "last_health": p.last_health,
+                "scraped_metrics": bool(p.last_metrics),
+                "metrics_has_namespace": "goltpu_" in p.last_metrics,
+            })
+            p.close()
+    return results
+
+
+def check_invariants(args, results: dict, specs: List[dict],
+                     plan: FaultPlan) -> List[str]:
+    """Every failed invariant becomes one human-readable line."""
+    import numpy as np
+
+    failures: List[str] = []
+
+    def report_of(entry) -> dict:
+        return entry.get("report") or {}
+
+    for kind in ("workers", "oracles"):
+        for entry in results[kind]:
+            r = report_of(entry)
+            if entry["exit_code"] != 0 or not r.get("ok"):
+                failures.append(
+                    f"{entry['workdir']}: exit={entry['exit_code']} "
+                    f"ok={r.get('ok')} error={r.get('error')}")
+
+    # fault-kind floor: state corruption + stall from worker reports,
+    # SIGKILL from the driver's own accounting
+    applied = [f for entry in results["workers"]
+               for m in report_of(entry).get("members", [])
+               for f in m.get("faults_applied", [])]
+    applied_kinds = {f["kind"] for f in applied}
+    if not applied_kinds & set(STATE_KINDS):
+        failures.append(f"no state-corruption fault applied ({applied_kinds})")
+    if "stall" not in applied_kinds:
+        failures.append(f"no stall fault applied ({applied_kinds})")
+    if plan_kills(plan, specs) and not results["killed"]:
+        failures.append("kill was scheduled but never executed "
+                        "(workers finished between polls?)")
+
+    for i, (w, o) in enumerate(zip(results["workers"], results["oracles"])):
+        wf = Path(w["workdir"]) / "final.npy"
+        of = Path(o["workdir"]) / "final.npy"
+        if not (wf.exists() and of.exists()):
+            failures.append(f"w{i}: missing final grid "
+                            f"({wf.exists()=} {of.exists()=})")
+            continue
+        if not np.array_equal(np.load(wf), np.load(of)):
+            failures.append(
+                f"w{i}: faulted-and-recovered final grid differs from "
+                f"oracle ({specs[i]['flavor']})")
+
+    for i, entry in enumerate(results["workers"]):
+        r = report_of(entry)
+        stalls_injected = sum(
+            1 for m in r.get("members", [])
+            for f in m.get("faults_applied", []) if f["kind"] == "stall")
+        if stalls_injected:
+            if r.get("stalls_detected", 0) < stalls_injected:
+                failures.append(
+                    f"w{i}: {stalls_injected} stalls injected but only "
+                    f"{r.get('stalls_detected', 0)} detected")
+            if not (Path(entry["workdir"]) / "flight.jsonl").exists():
+                failures.append(f"w{i}: induced stall left no flight dump")
+            elif r.get("flight_dumps", 0) < 1:
+                failures.append(f"w{i}: flight recorder never dumped "
+                                f"despite {stalls_injected} stalls")
+        retraces = sum(
+            1 for m in r.get("members", [])
+            for f in m.get("faults_applied", []) if f["kind"] == "retrace")
+        attributed = sum(m.get("supervisor", {}).get(
+            "retraces_attributed", 0) for m in r.get("members", []))
+        if retraces and attributed < retraces:
+            failures.append(f"w{i}: {retraces} retraces injected, "
+                            f"{attributed} attributed")
+        if not entry["metrics_has_namespace"]:
+            failures.append(f"w{i}: /metrics never served goltpu_ content")
+
+    for k in results["killed"]:
+        r = report_of(results["workers"][k["worker"]])
+        if not r.get("resume"):
+            failures.append(
+                f"w{k['worker']}: killed but final report says it never "
+                "resumed from checkpoint")
+    return failures
+
+
+def plan_kills(plan: FaultPlan, specs: List[dict]) -> List[int]:
+    return [e.worker for e in plan.events if e.kind == "kill"
+            and specs[e.worker]["flavor"] != "ensemble"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fleet soak under a deterministic fault plan")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--duration", type=float, default=60.0,
+                        help="soft wall-clock budget (seconds); workers "
+                        "exceeding it + grace are killed and failed")
+    parser.add_argument("--processes", type=int, default=3)
+    parser.add_argument("--generations", type=int, default=240)
+    parser.add_argument("--checkpoint-every", type=int, default=40)
+    parser.add_argument("--faults-per-worker", type=int, default=2)
+    parser.add_argument("--kills", type=int, default=1)
+    parser.add_argument("--watchdog-deadline", type=float, default=6.0)
+    parser.add_argument("--chunk-sleep", type=float, default=0.3)
+    parser.add_argument("--poll-seconds", type=float, default=0.1)
+    parser.add_argument("--grace", type=float, default=120.0,
+                        help="extra seconds past --duration before the "
+                        "driver declares a hang")
+    parser.add_argument("--out", default=None,
+                        help="output dir (default: ./soak_out)")
+    parser.add_argument("--tpu", action="store_true",
+                        help="do not force JAX_PLATFORMS=cpu in workers")
+    args = parser.parse_args(argv)
+
+    out = Path(args.out or os.path.join(_REPO, "soak_out"))
+    out.mkdir(parents=True, exist_ok=True)
+
+    # kills target the first --kills non-ensemble workers, so resume is
+    # exercised on a single-member checkpoint
+    kill_targets = [w for w in range(args.processes)
+                    if FLAVOR_ORDER[w % len(FLAVOR_ORDER)] != "ensemble"
+                    ][:args.kills]
+    plan = FaultPlan.generate(
+        args.seed, workers=args.processes, horizon=args.generations,
+        faults_per_worker=args.faults_per_worker,
+        kinds=("corrupt_region", "drop_region", "drop_shard", "stall",
+               "retrace"),
+        ensure_kinds=("corrupt_region", "stall", "retrace"),
+        kill_workers=kill_targets)
+    (out / "faultplan.json").write_text(plan.to_json())
+    print(f"soak: seed={args.seed} processes={args.processes} "
+          f"plan kinds={plan.kinds()} "
+          f"({len(plan.events)} events)", flush=True)
+
+    specs = build_specs(args, out, plan)
+    env = dict(os.environ, GOLTPU_SANITIZE="1",
+               GOLTPU_CACHE_DIR=os.environ.get(
+                   "GOLTPU_CACHE_DIR",
+                   os.path.join(_REPO, ".goltpu_cache")))
+    if not args.tpu:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    t0 = time.perf_counter()
+    results = run_fleet(args, out, specs, plan, env)
+    wall = time.perf_counter() - t0
+    failures = check_invariants(args, results, specs, plan)
+
+    report = {
+        "seed": args.seed,
+        "plan": json.loads(plan.to_json()),
+        "wall_seconds": round(wall, 2),
+        "results": results,
+        "invariant_failures": failures,
+        "ok": not failures,
+    }
+    (out / "soak_report.json").write_text(json.dumps(report, indent=2))
+    if failures:
+        print(f"soak: FAILED after {wall:.1f}s "
+              f"({len(failures)} invariant failures):", flush=True)
+        for f in failures:
+            print(f"  - {f}", flush=True)
+        return 1
+    print(f"soak: OK in {wall:.1f}s — {args.processes} workers, "
+          f"{len(plan.events)} scheduled faults "
+          f"({', '.join(plan.kinds())}), {len(results['killed'])} "
+          "kill/resume cycles, all grids bit-identical to oracle",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,426 @@
+"""The resilience layer (resilience/): supervised restart-with-rollback,
+deterministic fault plans, and the crash-safe checkpoint discipline they
+stand on.
+
+Covers the ISSUE-11 acceptance points: bit-exact restore after a
+validator trip and after injected corruption (recovered run == unfaulted
+oracle, generation for generation); the capped-exponential backoff
+schedule and the max-restarts circuit breaker; FaultPlan determinism and
+JSON round-trip; stall detection wired through the StallWatchdog with a
+flight dump; retrace injection attributed by the supervisor's sentinel;
+and the kill-during-save subprocess test proving ``checkpoint.save``
+never leaves a torn file where a good checkpoint used to be.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.coordinator import GridCoordinator
+from gameoflifewithactors_tpu.obs import flight as obs_flight
+from gameoflifewithactors_tpu.obs import watchdog as obs_watchdog
+from gameoflifewithactors_tpu.resilience import (
+    ALL_KINDS,
+    CircuitOpenError,
+    FaultEvent,
+    FaultPlan,
+    RestartPolicy,
+    Supervisor,
+    apply_fault,
+)
+from gameoflifewithactors_tpu.utils import checkpoint as ckpt_lib
+from gameoflifewithactors_tpu.utils import fault as fault_lib
+
+
+def _coordinator(backend="dense", shape=(64, 64), seed=7):
+    return GridCoordinator(shape, "B3/S23", random_fill=0.35,
+                           rng_seed=seed, backend=backend)
+
+
+def _oracle_grid(generations, backend="dense", shape=(64, 64), seed=7):
+    c = _coordinator(backend=backend, shape=shape, seed=seed)
+    c.tick(generations)
+    return c.snapshot()
+
+
+# -- the restart policy in isolation ------------------------------------------
+
+
+def test_backoff_is_capped_exponential():
+    p = RestartPolicy(backoff_initial_seconds=0.1, backoff_max_seconds=1.0,
+                      backoff_factor=2.0)
+    assert [p.backoff(n) for n in (1, 2, 3, 4, 5, 6)] == \
+        [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+
+def test_supervisor_rejects_bad_cadence(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        Supervisor(_coordinator(), checkpoint_path=str(tmp_path / "c.npz"),
+                   checkpoint_every=0)
+
+
+# -- rollback correctness ------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["dense", "packed"])
+def test_injected_corruption_recovers_bit_exact(tmp_path, backend):
+    """A corrupted-then-restored run ends bit-identical to a run that
+    never faulted — restores come from validated checkpoints and the
+    lost generations are replayed deterministically."""
+    sup = Supervisor(_coordinator(backend=backend),
+                     checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=20, sleep_fn=lambda s: None)
+    fired = []
+
+    def before_chunk(gen):
+        if 20 <= gen < 40 and not fired:
+            fired.append(gen)
+            sup.inject("corrupt_region",
+                       lambda e: fault_lib.corrupt_region(
+                           e, 4, 4, 16, 16, seed=99))
+
+    sup.before_chunk = before_chunk
+    stats = sup.run(100)
+    assert fired, "the fault never fired — the test is vacuous"
+    assert stats["restarts_by_cause"] == {"fault:corrupt_region": 1}
+    assert stats["generation"] == 100
+    np.testing.assert_array_equal(sup.coordinator.snapshot(),
+                                  _oracle_grid(100, backend=backend))
+
+
+def test_validator_trip_restores_and_counts(tmp_path):
+    """Dropping the whole grid trips the min-population validator; the
+    supervisor rolls back and the final state still matches the oracle."""
+    coordinator = _coordinator()
+    h, w = coordinator.engine.shape
+    sup = Supervisor(coordinator, checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=25,
+                     validators=[fault_lib.population_bounds_validator(
+                         min_pop=1)],
+                     sleep_fn=lambda s: None)
+    dropped = []
+
+    def before_chunk(gen):
+        if gen == 25 and not dropped:
+            dropped.append(gen)
+            # bypass inject(): an *undetected* fault, found by the
+            # validator at the boundary, is the channel under test
+            fault_lib.drop_region(coordinator.engine, 0, 0, h, w)
+
+    sup.before_chunk = before_chunk
+    stats = sup.run(75)
+    assert dropped
+    assert stats["validator_trips"] == 1
+    assert stats["restarts_by_cause"] == {"validator": 1}
+    np.testing.assert_array_equal(coordinator.snapshot(), _oracle_grid(75))
+
+
+def test_restore_resumes_generation_for_generation(tmp_path):
+    """After a restart, every subsequent chunk boundary lands on the
+    same generations the oracle passes through."""
+    sup = Supervisor(_coordinator(), checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10, sleep_fn=lambda s: None)
+    seen = []
+    faulted = []
+
+    def before_chunk(gen):
+        seen.append(gen)
+        if gen == 20 and not faulted:
+            faulted.append(gen)
+            sup.inject("drop_region",
+                       lambda e: fault_lib.drop_region(e, 0, 0, 8, 8))
+
+    sup.before_chunk = before_chunk
+    sup.run(50)
+    # gen 20 appears twice: once faulted, once replayed clean
+    assert seen == [0, 10, 20, 20, 30, 40]
+    for boundary_gen in (10, 20, 30, 40, 50):
+        np.testing.assert_array_equal(
+            sup.coordinator.snapshot() if boundary_gen == 50 else
+            _oracle_grid(boundary_gen), _oracle_grid(boundary_gen))
+
+
+def test_on_restart_callback_and_notify(tmp_path):
+    coordinator = _coordinator()
+    calls = []
+    frames = []
+    coordinator.subscribe(lambda frame: frames.append(frame.generation))
+    sup = Supervisor(coordinator, checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10, sleep_fn=lambda s: None,
+                     on_restart=lambda *a: calls.append(a))
+    sup.before_chunk = (lambda gen: sup.inject(
+        "drop_region", lambda e: fault_lib.drop_region(e, 0, 0, 4, 4))
+        if gen == 10 and not calls else None)
+    sup.run(30)
+    assert calls == [("fault:drop_region", 10, 1)]
+    # subscribers saw the rollback notify (generation 10 re-announced)
+    assert frames.count(10) >= 2
+
+
+# -- backoff + circuit breaker -------------------------------------------------
+
+
+def test_backoff_schedule_honored_then_circuit_opens(tmp_path):
+    """A fault injected before *every* chunk fails forever: the recorded
+    sleeps must follow the policy's capped exponential, and the breaker
+    must open after max_restarts consecutive failures."""
+    sleeps = []
+    policy = RestartPolicy(max_restarts=4, backoff_initial_seconds=0.1,
+                           backoff_max_seconds=0.4, backoff_factor=2.0)
+    sup = Supervisor(_coordinator(), checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10, policy=policy,
+                     sleep_fn=sleeps.append)
+    sup.before_chunk = lambda gen: sup.inject(
+        "corrupt_region",
+        lambda e: fault_lib.corrupt_region(e, 0, 0, 8, 8, seed=1))
+    with pytest.raises(CircuitOpenError, match="max_restarts=4"):
+        sup.run(100)
+    assert sleeps == [0.1, 0.2, 0.4, 0.4]  # 4 restarts, then give up
+    stats = sup.stats()
+    assert stats["circuit_open"] is True
+    assert stats["restarts"] == 4
+
+
+def test_clean_chunk_resets_failure_streak(tmp_path):
+    """max_restarts counts *consecutive* failures: alternating
+    fault/clean chunks never open the circuit."""
+    sup = Supervisor(_coordinator(),
+                     checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10,
+                     policy=RestartPolicy(max_restarts=1),
+                     sleep_fn=lambda s: None)
+    flips = {"n": 0}
+
+    def before_chunk(gen):
+        flips["n"] += 1
+        if flips["n"] % 2:
+            sup.inject("drop_region",
+                       lambda e: fault_lib.drop_region(e, 0, 0, 4, 4))
+
+    sup.before_chunk = before_chunk
+    stats = sup.run(40)  # 4 clean chunks needed; ~8 boundary visits
+    assert stats["restarts"] == 4
+    assert stats["circuit_open"] is False
+    np.testing.assert_array_equal(sup.coordinator.snapshot(), _oracle_grid(40))
+
+
+# -- stall + retrace channels --------------------------------------------------
+
+
+def test_stall_detected_restored_and_flight_dumped(tmp_path):
+    """An induced stall (subscriber sleeping past the deadline inside
+    the watched tick) is flagged by the armed watchdog, dumps flight,
+    and the supervisor restores — final grid still oracle-exact."""
+    wd = obs_watchdog.arm(obs_watchdog.StallWatchdog(0.3))
+    fr = obs_flight.FlightRecorder(str(tmp_path / "flight.jsonl"))
+    fr.install(watchdog=wd)  # before arm(): see resilience/worker.py
+    obs_flight.arm(fr)
+    try:
+        from gameoflifewithactors_tpu.resilience import induce_stall
+
+        sup = Supervisor(_coordinator(),
+                         checkpoint_path=str(tmp_path / "c.npz"),
+                         checkpoint_every=15, sleep_fn=lambda s: None)
+        stalled = []
+
+        def before_chunk(gen):
+            if gen == 15 and not stalled:
+                stalled.append(gen)
+                sup.inject("stall", lambda e: induce_stall(
+                    sup.coordinator, 0.8))
+
+        sup.before_chunk = before_chunk
+        stats = sup.run(45)
+        assert stats["stalls_detected"] >= 1
+        assert stats["restarts_by_cause"] == {"fault:stall": 1}
+        assert fr.dumps >= 1
+        assert "stall" in (fr.last_dump_reason or "")
+        np.testing.assert_array_equal(sup.coordinator.snapshot(),
+                                      _oracle_grid(45))
+    finally:
+        obs_flight.disarm()
+        obs_watchdog.disarm()
+
+
+def test_induced_retrace_attributed_not_rolled_back(tmp_path):
+    from gameoflifewithactors_tpu.resilience import induce_retrace
+
+    sup = Supervisor(_coordinator(), checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10, sleep_fn=lambda s: None)
+    poked = []
+
+    def before_chunk(gen):
+        if gen == 10 and not poked:
+            poked.append(gen)
+            sup.inject("retrace", lambda e: induce_retrace())
+
+    sup.before_chunk = before_chunk
+    stats = sup.run(30)
+    assert poked
+    assert stats["retraces_attributed"] == 1
+    assert stats["restarts"] == 0  # no state harmed, no rollback
+    np.testing.assert_array_equal(sup.coordinator.snapshot(), _oracle_grid(30))
+
+
+# -- FaultPlan -----------------------------------------------------------------
+
+
+def test_faultplan_same_seed_same_schedule():
+    a = FaultPlan.generate(123, workers=4, horizon=200,
+                           ensure_kinds=("stall", "retrace"),
+                           kill_workers=(1,))
+    b = FaultPlan.generate(123, workers=4, horizon=200,
+                           ensure_kinds=("stall", "retrace"),
+                           kill_workers=(1,))
+    assert a == b
+    c = FaultPlan.generate(124, workers=4, horizon=200,
+                           ensure_kinds=("stall", "retrace"),
+                           kill_workers=(1,))
+    assert a != c
+
+
+def test_faultplan_json_roundtrip_and_coverage():
+    plan = FaultPlan.generate(5, workers=3, horizon=120,
+                              faults_per_worker=4,
+                              ensure_kinds=("corrupt_region", "stall",
+                                            "retrace"),
+                              kill_workers=(0, 2))
+    again = FaultPlan.from_json(plan.to_json())
+    assert again == plan
+    kinds = set(plan.kinds())
+    assert {"corrupt_region", "stall", "retrace", "kill"} <= kinds
+    assert kinds <= set(ALL_KINDS)
+    lo, hi = 120 // 4, (3 * 120) // 4
+    for e in plan.events:
+        assert lo <= e.at_gen <= hi
+    assert [e.worker for e in plan.for_worker(2)] == \
+        [2] * len(plan.for_worker(2))
+    assert all(e.kind == "kill" for e in plan.for_worker(0, kinds=("kill",)))
+
+
+def test_faultplan_rejects_degenerate_inputs():
+    with pytest.raises(ValueError, match="workers"):
+        FaultPlan.generate(0, workers=0, horizon=100)
+    with pytest.raises(ValueError, match="horizon"):
+        FaultPlan.generate(0, workers=1, horizon=4)
+
+
+def test_apply_fault_degrades_shard_kinds_without_mesh(tmp_path):
+    """On an unsharded engine the shard kinds degrade to region form —
+    one plan stays valid across every worker flavor."""
+    sup = Supervisor(_coordinator(), checkpoint_path=str(tmp_path / "c.npz"),
+                     checkpoint_every=10, sleep_fn=lambda s: None)
+    hits = []
+    sup.before_chunk = (lambda gen: hits.append(apply_fault(
+        sup, FaultEvent(worker=0, at_gen=10, kind="drop_shard",
+                        params={"shard_f": 0.5})))
+        if gen == 10 and not hits else None)
+    stats = sup.run(30)
+    assert hits == ["drop_region"]
+    assert stats["restarts_by_cause"] == {"fault:drop_region": 1}
+    np.testing.assert_array_equal(sup.coordinator.snapshot(), _oracle_grid(30))
+
+
+# -- crash-safe checkpoint save ------------------------------------------------
+
+_KILL_LOOP = """
+import sys
+from gameoflifewithactors_tpu.coordinator import GridCoordinator
+from gameoflifewithactors_tpu.utils import checkpoint as ckpt_lib
+
+c = GridCoordinator((64, 64), "B3/S23", random_fill=0.4, rng_seed=3,
+                    backend="dense")
+path = sys.argv[1]
+ckpt_lib.save(c.engine, path)
+print("FIRST_SAVE_DONE", flush=True)
+while True:
+    c.tick(1)
+    ckpt_lib.save(c.engine, path)
+"""
+
+
+def test_kill_during_save_leaves_previous_checkpoint_intact(tmp_path):
+    """SIGKILL a process that is saving in a tight loop; whatever made
+    it to ``path`` must still be a loadable checkpoint (the atomic
+    tmp+rename discipline), never a torn write."""
+    path = tmp_path / "ck.npz"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", _KILL_LOOP, str(path)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, env=env, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "FIRST_SAVE_DONE" in line
+        # let it overwrite mid-flight a few times, then kill without grace
+        time.sleep(1.0)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    grid, meta = ckpt_lib.load_grid(path)
+    assert grid.shape == (64, 64)
+    assert meta["generation"] >= 0
+    # no abandoned temp file masquerading as the checkpoint
+    leftovers = [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+    for p in leftovers:  # tolerated on disk, but never the load target
+        assert p.name != path.name
+
+
+def test_save_failure_cleans_up_temp_file(tmp_path, monkeypatch):
+    c = _coordinator(shape=(32, 32))
+    path = tmp_path / "ck.npz"
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="disk full"):
+        ckpt_lib.save(c.engine, path)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert list(tmp_path.iterdir()) == []  # tmp unlinked, nothing torn
+
+
+# -- the worker, end to end ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_worker_subprocess_recovers_and_reports(tmp_path):
+    """One soak worker process: injected corruption + retrace, exits 0,
+    report shows the restart and the attribution."""
+    plan = [
+        FaultEvent(worker=0, at_gen=30, kind="corrupt_region",
+                   params={"top_f": 0.1, "left_f": 0.1, "h_f": 0.25,
+                           "w_f": 0.25, "seed": 11}).to_dict(),
+        FaultEvent(worker=0, at_gen=50, kind="retrace").to_dict(),
+    ]
+    workdir = tmp_path / "w0"
+    spec = {"name": "t-worker", "flavor": "packed", "shape": [64, 64],
+            "generations": 80, "checkpoint_every": 20, "rng_seed": 5,
+            "workdir": str(workdir), "watchdog_deadline": 5.0,
+            "events": plan}
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GOLTPU_SANITIZE="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "gameoflifewithactors_tpu.resilience.worker",
+         "--spec", str(spec_path)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.startswith("METRICS_PORT ")
+    report = json.loads((workdir / "report.json").read_text())
+    assert report["ok"] is True
+    member = report["members"][0]
+    assert member["final_generation"] == 80
+    assert member["supervisor"]["restarts_by_cause"] == \
+        {"fault:corrupt_region": 1}
+    assert member["supervisor"]["retraces_attributed"] == 1
+    assert (workdir / "final.npy").exists()
