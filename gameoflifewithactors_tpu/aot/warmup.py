@@ -15,32 +15,84 @@ The manifest is a JSON list of EngineSpec dicts::
      {"rule": "brain", "shape": [1024, 1024], "backend": "packed"},
      {"rule": "R2,C0,M1,S2..6,B3..5,NM", "shape": [512, 512],
       "backend": "packed", "topology": "dead"}]
+
+An entry may additionally carry a **lane ladder** — the batch
+capacities the session service (serve/lanes.py) will dispatch this rule
+family at::
+
+    [{"rule": "B3/S23", "shape": [256, 256], "backend": "packed",
+      "lanes": [1, 8, 64, 256]}]
+
+Lane entries trace the *masked batched* runner at every listed capacity
+(``serve.lanes.warm_family``), so a fresh server process warm-starts
+every lane shape it will ever use — placement, growth, and compaction
+across the ladder then cause zero post-warm ``cache_miss`` events.
+``results/serve_manifest.json`` is the shipped example. Extras such as
+``lanes`` are manifest-level vocabulary: they are peeled off before
+``EngineSpec.from_dict`` (which by design rejects unknown fields).
 """
 
 from __future__ import annotations
 
 import json
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence, Tuple
 
 from . import cache as cache_lib
 from . import registry as registry_lib
 from .spec import EngineSpec
 
+# manifest keys that configure warmup itself rather than the engine;
+# EngineSpec.from_dict stays strict about everything else
+MANIFEST_EXTRAS = ("lanes",)
 
-def load_manifest(path: str) -> List[EngineSpec]:
+
+def load_manifest_entries(path: str) -> List[Tuple[EngineSpec, dict]]:
+    """Parse a manifest into (spec, extras) pairs, where ``extras`` holds
+    the warmup-level keys (:data:`MANIFEST_EXTRAS`) the entry carried."""
     with open(path) as f:
         entries = json.load(f)
     if not isinstance(entries, list):
         raise ValueError(
             f"manifest {path} must be a JSON list of spec objects")
-    return [EngineSpec.from_dict(e) for e in entries]
+    out: List[Tuple[EngineSpec, dict]] = []
+    for e in entries:
+        e = dict(e)
+        extras = {k: e.pop(k) for k in MANIFEST_EXTRAS if k in e}
+        if "lanes" in extras:
+            lanes = extras["lanes"]
+            if (not isinstance(lanes, list) or not lanes
+                    or not all(isinstance(c, int) and c > 0 for c in lanes)):
+                raise ValueError(
+                    f"manifest {path}: 'lanes' must be a non-empty list "
+                    f"of positive batch capacities, got {lanes!r}")
+        out.append((EngineSpec.from_dict(e), extras))
+    return out
 
 
-def warmup_spec(spec: EngineSpec, *, aot: bool = True) -> dict:
+def load_manifest(path: str) -> List[EngineSpec]:
+    return [spec for spec, _extras in load_manifest_entries(path)]
+
+
+def _warm_lanes(spec: EngineSpec, lanes: Sequence[int]) -> str:
+    """Trace the masked batched lane runner at each ladder capacity.
+    Imported lazily — aot/ must not pull the serve layer (and its jax
+    surface) in for manifest-only consumers."""
+    from ..serve import lanes as lanes_lib
+
+    d = spec.canonical()
+    d["mesh"] = None  # lanes are single-device by contract (serve/lanes.py)
+    family = lanes_lib.SpecFamily.from_spec(d)
+    lanes_lib.warm_family(family, tuple(int(c) for c in lanes))
+    return f"warmed {len(lanes)} capacities for {family.key}"
+
+
+def warmup_spec(spec: EngineSpec, *, aot: bool = True,
+                lanes: Optional[Sequence[int]] = None) -> dict:
     """Precompile one spec: build its engine, exercise the per-generation
-    and bulk runner signatures, optionally serialize the AOT runner.
-    Returns a report row (wall/compile seconds, event kinds, aot status).
+    and bulk runner signatures, optionally serialize the AOT runner and
+    trace the lane-ladder batch shapes. Returns a report row (wall/
+    compile seconds, event kinds, aot + lane status).
     """
     from ..obs import compile as obs_compile
 
@@ -64,12 +116,21 @@ def warmup_spec(spec: EngineSpec, *, aot: bool = True) -> dict:
             aot_status = f"unsupported: {exc}"
         except Exception as exc:  # pragma: no cover - env-dependent
             aot_status = f"failed: {type(exc).__name__}: {exc}"
+    lanes_status: Optional[str] = None
+    if lanes:
+        try:
+            lanes_status = _warm_lanes(spec, lanes)
+        except ValueError as exc:
+            # a family the lane layer refuses (multi-state rule, sharded
+            # mesh, unpackable width) is a manifest authoring error the
+            # report must surface, not a warmup crash
+            lanes_status = f"unsupported: {exc}"
     wall = time.perf_counter() - t0
     events = log.events()[n_before:]
     kinds: dict = {}
     for e in events:
         kinds[e.kind] = kinds.get(e.kind, 0) + 1
-    return {
+    row = {
         "spec": spec.canonical(),
         "resolved_backend": engine.backend,
         "wall_seconds": wall,
@@ -78,25 +139,33 @@ def warmup_spec(spec: EngineSpec, *, aot: bool = True) -> dict:
         "events": kinds,
         "aot": aot_status,
     }
+    if lanes:
+        row["lanes"] = {"capacities": list(lanes), "status": lanes_status}
+    return row
 
 
 def warmup_specs(specs, *, aot: bool = True, cache_dir: Optional[str] = None,
                  verbose=None) -> List[dict]:
     """The pipeline: enable the persistent cache, then warm every spec.
+    ``specs`` is a list of EngineSpec or (EngineSpec, extras) pairs (the
+    :func:`load_manifest_entries` shape — extras may carry ``lanes``).
     ``verbose`` is a print-like callable for progress lines (or None)."""
     enabled = cache_lib.ensure_persistent_cache(cache_dir)
     if verbose:
         verbose(f"persistent compilation cache: {enabled or 'DISABLED'}")
     rows = []
-    for spec in specs:
+    for item in specs:
+        spec, extras = item if isinstance(item, tuple) else (item, {})
         if verbose:
             verbose(f"warming {spec.describe()} ...")
-        row = warmup_spec(spec, aot=aot)
+        row = warmup_spec(spec, aot=aot, lanes=extras.get("lanes"))
         rows.append(row)
         if verbose:
             verbose(
                 f"  {row['wall_seconds']:.2f}s wall, "
                 f"{row['compile_seconds']:.2f}s compiling, "
                 f"events {row['events'] or '{}'}"
-                + (f", aot: {row['aot']}" if row["aot"] else ""))
+                + (f", aot: {row['aot']}" if row["aot"] else "")
+                + (f", lanes: {row['lanes']['status']}"
+                   if row.get("lanes") else ""))
     return rows

@@ -5,6 +5,10 @@
 scheduler → renderer/metrics → optional checkpoint, mirroring the
 reference's Program.main → ActorSystem → GridCoordinator startup
 (SURVEY.md §4a) as one construction path.
+
+Subcommands ride in front of the flags: ``report`` (RunReport summary /
+diff), ``warmup`` (precompile pipeline), ``serve`` (multi-tenant session
+service — README "Serving").
 """
 
 from __future__ import annotations
@@ -202,10 +206,13 @@ def _warmup_cmd(argv: Sequence[str]) -> int:
     from .utils.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
-    from .aot import EngineSpec, load_manifest, warmup_specs
+    from .aot import EngineSpec, warmup_specs
+    from .aot.warmup import load_manifest_entries
 
     if args.manifest:
-        specs = load_manifest(args.manifest)
+        # (spec, extras) pairs: entries carrying a "lanes" ladder also
+        # warm the serve layer's masked batched runners (README "Serving")
+        specs = load_manifest_entries(args.manifest)
     else:
         cfg, _ = from_args(rest)
         specs = [EngineSpec.from_config(cfg)]
@@ -231,6 +238,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _report_cmd(argv[1:])
     if argv and argv[0] == "warmup":
         return _warmup_cmd(argv[1:])
+    if argv and argv[0] == "serve":
+        # multi-tenant session service (README "Serving"): packs live
+        # grid sessions onto batched lanes behind an HTTP/JSON API
+        from .serve.frontend import main as serve_main
+
+        return serve_main(list(argv[1:]))
 
     from .utils.platform import honor_jax_platforms_env
 

@@ -127,11 +127,20 @@ class MetricsServer:
     def port(self) -> Optional[int]:
         return self._httpd.server_address[1] if self._httpd else None
 
+    def set_health_info(self, health_info: Optional[Callable[[], dict]]
+                        ) -> None:
+        """Install or replace the /healthz info hook after construction.
+        The serve layer starts the exporter first (scrapable during
+        warmup) and wires its live session/lane/queue counts in once the
+        session service exists; the handler reads the hook per request,
+        so the swap needs no restart."""
+        self._health_info = health_info
+
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
             return self
         registry = self.registry
-        health_info = self._health_info
+        srv = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -142,6 +151,7 @@ class MetricsServer:
                     ctype = CONTENT_TYPE
                 elif path == "/healthz":
                     payload = {"ok": True}
+                    health_info = srv._health_info  # late-bound per request
                     if health_info is not None:
                         try:
                             payload.update(health_info() or {})

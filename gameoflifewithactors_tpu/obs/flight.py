@@ -156,16 +156,7 @@ class FlightRecorder:
         except ValueError:
             name = str(signum)
         self.dump(f"signal {name}")
-        prev = self._prev_handlers.get(signum)
-        if callable(prev):
-            prev(signum, frame)
-        elif prev == signal.SIG_DFL:
-            # restore the default and re-raise so the process still dies
-            # with the right signal disposition (a harness watching the
-            # exit status must see SIGTERM, not a clean exit)
-            signal.signal(signum, signal.SIG_DFL)
-            os.kill(os.getpid(), signum)
-        # SIG_IGN / None: dump taken, signal swallowed as before
+        _continue_previous(self._prev_handlers.get(signum), signum, frame)
 
     # -- the crash report ----------------------------------------------------
 
@@ -221,6 +212,57 @@ class FlightRecorder:
         sys.stderr.write(
             f"flight recorder: dumped ({reason}) -> {self.path}\n")
         return self.path
+
+
+def _continue_previous(prev, signum, frame) -> None:
+    """Hand a handled signal on to the disposition that was installed
+    before us — the one chaining rule every SIGTERM hook in this
+    codebase must follow (FlightRecorder.install, the serve loop, the
+    soak worker). A callable previous handler is called; SIG_DFL is
+    restored and the signal re-raised so the process still dies with the
+    right disposition (a harness watching the exit status must see
+    SIGTERM, not a clean exit); SIG_IGN / None swallow it."""
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_DFL:
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def chain_signal_handler(sig, fn, *, propagate: bool = True):
+    """Install ``fn(signum, frame)`` for ``sig`` WITHOUT dropping the
+    handler already there: ``fn`` runs first, then (with ``propagate``)
+    the previous disposition via :func:`_continue_previous`.
+
+    This is the fix for the two-installers hazard: a later component
+    calling raw ``signal.signal`` silently discards whatever hook was
+    installed before it — e.g. the serve loop's graceful-shutdown hook
+    replacing the flight recorder's dump-on-SIGTERM (or vice versa),
+    losing either the crash dump or the final checkpoint. Every
+    additional SIGTERM/SIGINT hook should install through here (or
+    through ``FlightRecorder.install``, which follows the same rule).
+
+    Returns an ``uninstall()`` callable that restores the previous
+    handler — only if the chained one is still installed, the same
+    steal-safe discipline as ``FlightRecorder.uninstall``.
+    """
+    prev = signal.getsignal(sig)
+
+    def handler(signum, frame):
+        fn(signum, frame)
+        if propagate:
+            _continue_previous(prev, signum, frame)
+
+    signal.signal(sig, handler)
+
+    def uninstall() -> None:
+        try:
+            if signal.getsignal(sig) is handler:
+                signal.signal(sig, prev)
+        except (ValueError, OSError):
+            pass
+
+    return uninstall
 
 
 def load_dump(path: str) -> dict:

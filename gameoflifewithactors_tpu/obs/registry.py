@@ -133,8 +133,23 @@ class MetricsRegistry:
         return self._get(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
-        return self._get(Histogram, name, help, buckets=buckets)
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        """Get-or-create. ``buckets=None`` means "whatever this
+        instrument has" (DEFAULT_BUCKETS when creating) — so generic
+        call sites compose with instruments registered under custom
+        boundaries (queue-wait seconds are not step-latency decades).
+        Passing explicit buckets that CONFLICT with an existing
+        instrument raises: silently observing into someone else's
+        boundaries is the bug this guard exists for."""
+        inst = self._get(Histogram, name, help,
+                         buckets=buckets if buckets is not None
+                         else DEFAULT_BUCKETS)
+        if buckets is not None and inst.buckets != tuple(sorted(buckets)):
+            raise ValueError(
+                f"histogram {name!r} is registered with buckets "
+                f"{inst.buckets}, not {tuple(sorted(buckets))}; pick a "
+                "different name or drop the buckets argument")
+        return inst
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -60,13 +60,39 @@ def batch_band_sharding(mesh: Mesh) -> NamedSharding:
 
 def make_multi_step_packed_batched(
     mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
-    donate: bool = False,
+    donate: bool = False, masked: bool = False,
 ) -> Callable:
-    """Jitted (grids, n) -> grids over a (B, H, W/32) packed batch."""
+    """Jitted (grids, n) -> grids over a (B, H, W/32) packed batch.
+
+    With ``masked=True`` the runner takes ``(grids, n, mask)`` where
+    ``mask`` is a (B,) uint32 occupancy vector: universes with mask 0 are
+    frozen (their words pass through every generation unchanged) while
+    the rest step normally. This is the serving layer's lane contract —
+    dead/idle session slots ride along in the batch at zero semantic
+    cost, so a lane never needs a retrace to change which slots are live
+    (the mask is a runtime operand, not part of the jit signature)."""
     nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
 
     def universe_gen(tile):
         return step_packed_ext(exchange_halo(tile, nx, ny, topology), rule)
+
+    if masked:
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(_SPEC, P(), P(BATCH_AXIS)), out_specs=_SPEC)
+        def _run_masked(tiles, n, mask):
+            gen = jax.vmap(universe_gen)
+            live = mask[:, None, None] != 0
+
+            def body(_, t):
+                # frozen slots still pay the stencil FLOPs (branch-free
+                # dataflow); the select keeps their words bit-identical
+                return jax.numpy.where(live, gen(t), t)
+
+            return jax.lax.fori_loop(0, n, body, tiles)
+
+        return tracked_jit(
+            _run_masked, runner="batched.multi_step_packed_batched_masked",
+            donate_argnums=(0,) if donate else ())
 
     @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
     def _run(tiles, n):
@@ -84,7 +110,7 @@ def make_multi_step_pallas_batched(
     gens_per_exchange: int = 8,
     block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
-    donate: bool = False,
+    donate: bool = False, masked: bool = False,
 ) -> Callable:
     """The DP × native-kernel corner of the parallelism matrix: a
     (nb, nx, ny) mesh where every device advances its universes'
@@ -99,7 +125,12 @@ def make_multi_step_pallas_batched(
     pallas_call is unsupported territory.
 
     Returns jitted ``(grids, chunks) -> grids`` over a (B, H, W/32) packed
-    batch advancing ``chunks * g`` generations.
+    batch advancing ``chunks * g`` generations. With ``masked=True`` the
+    signature is ``(grids, chunks, mask)`` — same (B,) uint32 occupancy
+    contract as :func:`make_multi_step_packed_batched`: mask-0 universes
+    come out bit-identical to their input (the select is applied per
+    chunk, after the kernel, so frozen slots never drift even though
+    their bands still flow through the DMA pipeline).
     """
     from ..ops.pallas_stencil import default_interpret, make_pallas_slab_step
     from .halo import band_edge_code, exchange_rows_stack
@@ -129,6 +160,22 @@ def make_multi_step_pallas_batched(
         else:
             out = [call(ext[i])[g:-g] for i in range(ext.shape[0])]
         return jax.numpy.stack(out)
+
+    if masked:
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(spec, P(), P(BATCH_AXIS)), out_specs=spec,
+                 check_vma=False)
+        def _run_masked(tiles, n, mask):
+            live = mask[:, None, None] != 0
+
+            def body(_, t):
+                return jax.numpy.where(live, chunk(t), t)
+
+            return jax.lax.fori_loop(0, n, body, tiles)
+
+        return tracked_jit(
+            _run_masked, runner="batched.multi_step_pallas_batched_masked",
+            donate_argnums=(0,) if donate else ())
 
     # check_vma=False: same scratch-DMA typing limitation as
     # sharded.make_multi_step_pallas

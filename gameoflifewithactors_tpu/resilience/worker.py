@@ -163,6 +163,17 @@ def run_spec(spec: dict, *, resume: bool = False,
     fr = obs_flight.FlightRecorder(str(workdir / "flight.jsonl"))
     fr.install(watchdog=wd)
     obs_flight.arm(fr)
+    # a driver SIGTERM is external: chain a tape note IN FRONT of the
+    # recorder's dump-then-die handler (chain_signal_handler — never raw
+    # signal.signal, which would silently drop the dump hook; the serve
+    # loop follows the same rule). No lock in the note: the handler runs
+    # on the main thread and must not wait on health_lock mid-signal.
+    import signal as signal_lib
+
+    unchain = obs_flight.chain_signal_handler(
+        signal_lib.SIGTERM,
+        lambda signum, frame: fr.note(
+            "worker_sigterm", {"member": health.get("member", 0)}))
     server = obs_exporter.serve_metrics(
         int(spec.get("metrics_port", 0)),
         host=spec.get("metrics_host", "127.0.0.1"),
@@ -197,6 +208,7 @@ def run_spec(spec: dict, *, resume: bool = False,
         tmp.write_text(json.dumps(report, indent=2))
         os.replace(tmp, workdir / "report.json")
         server.stop()
+        unchain()
         obs_flight.disarm()
         obs_watchdog.disarm()
     return code

@@ -1,0 +1,369 @@
+"""Lanes: few physical executors for many logical sessions.
+
+A lane is one batched dispatch surface: a ``(capacity, H, W/32)`` packed
+batch driven by the masked DP runner
+(``parallel.batched.make_multi_step_packed_batched(masked=True)``) on a
+single-device (1, 1, 1) batch mesh. Sessions of the same
+:class:`SpecFamily` (rule × shape × topology × backend) share lanes;
+each owns one batch slot. The occupancy mask is a *runtime operand*, so
+slots can be claimed, freed, and frozen without ever changing the jit
+signature — the lever every serving decision here leans on:
+
+- **Fixed capacity ladder** (:data:`LANE_LADDER`, default 1/8/64/256):
+  lane batch shapes are drawn from a small closed set, so the warmup
+  pass (aot/warmup.py lane entries) can pre-trace every executable the
+  server will ever dispatch. Growth, shrink, and compaction move
+  sessions *between* ladder shapes — they never mint a new one.
+- **Host-side state**: lane words live in writable numpy; slot surgery
+  (place/release/repack) is array copying on the host, invisible to the
+  compiled runner. Device-side scatter by slot index would compile one
+  executable per slot constant — the exact retrace storm the
+  RetraceSentinel exists to catch.
+- **Dynamic compaction**: after closes, live sessions are repacked into
+  the smallest ladder multiset that holds them (greedy from the largest
+  rung). Every target shape is pre-warmed, so compaction is free of
+  ``cache_miss`` events by construction — asserted by the retrace-budget
+  test, not just promised.
+
+The per-lane HBM-cost model admission control prices against is
+:meth:`SpecFamily.slot_bytes` × capacity (double-buffered packed words —
+the runner's donated/undonated in+out pair).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.generations import parse_any
+from ..models.rules import Rule
+from ..ops import bitpack
+from ..ops.stencil import Topology
+from ..parallel import batched
+
+# capacities a lane may have — the closed set of batch shapes the server
+# ever traces. Must be sorted ascending; 1 keeps singleton tenants cheap,
+# the top rung bounds lanes-per-family at ~N/256.
+LANE_LADDER = (1, 8, 64, 256)
+
+
+class SpecFamily:
+    """The lane-sharing equivalence class of an EngineSpec.
+
+    Two sessions share lanes iff rule notation, grid shape, topology and
+    lane backend all match — exactly the parameters that shape the
+    runner's lowered program (batch capacity is the one shape axis the
+    ladder varies).
+    """
+
+    def __init__(self, rule: str, height: int, width: int,
+                 topology: str = "torus", backend: str = "packed"):
+        parsed = parse_any(rule)
+        if not isinstance(parsed, Rule):
+            raise ValueError(
+                f"lanes serve binary life-like rules only, got {rule!r} "
+                f"({type(parsed).__name__}); multi-state families need "
+                "their own engine")
+        if backend not in ("packed", "pallas"):
+            raise ValueError(
+                f"lane backend must be 'packed' or 'pallas', got {backend!r}")
+        self.rule = parsed
+        self.height = int(height)
+        self.width = int(width)
+        self.wq = bitpack.packed_width(self.width)  # validates width % 32
+        self.topology = Topology(topology)
+        self.backend = backend
+        self.key = (f"{self.rule.notation}|{self.height}x{self.width}"
+                    f"|{self.topology.value}|{self.backend}")
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "SpecFamily":
+        """From an EngineSpec-shaped dict (the create-request body).
+        ``backend`` 'auto' resolves to the packed lane runner; sharded
+        meshes are a per-engine concern the lane layer refuses."""
+        d = dict(spec)
+        if d.get("mesh"):
+            raise ValueError(
+                "lane sessions are single-device (the batch axis IS the "
+                "parallelism); drop 'mesh' from the session spec")
+        backend = d.get("backend", "auto")
+        if backend == "auto":
+            backend = "packed"
+        if "shape" in d:
+            height, width = d["shape"]
+        else:
+            height, width = d["height"], d["width"]
+        return cls(d.get("rule", "B3/S23"), height, width,
+                   d.get("topology", "torus"), backend)
+
+    def canonical_spec(self) -> dict:
+        """The JSON-able spec stored on sessions and in checkpoints."""
+        return {"rule": self.rule.notation, "height": self.height,
+                "width": self.width, "topology": self.topology.value,
+                "backend": self.backend}
+
+    def slot_bytes(self) -> int:
+        """Modelled HBM cost of one occupied batch slot: packed words,
+        double-buffered (the runner's input + output live together at
+        dispatch)."""
+        return 2 * self.height * self.wq * 4
+
+    def describe(self) -> str:
+        return self.key
+
+
+# -- the runner cache ---------------------------------------------------------
+#
+# One masked runner per (rule, topology, backend): tracked_jit caches
+# compiled executables per batch shape inside it, so every lane of a
+# family — and every test in the process — shares warm executables.
+
+_RUNNERS: Dict[tuple, object] = {}
+_MESH = None
+_RUNNER_LOCK = threading.Lock()
+
+
+def _lane_mesh():
+    """The (1, 1, 1) single-device batch mesh every lane dispatches on.
+    Lanes are deliberately single-device: the batch axis is the
+    parallelism, and one mesh means one executable per ladder shape."""
+    global _MESH
+    with _RUNNER_LOCK:
+        if _MESH is None:
+            import jax
+
+            _MESH = batched.make_batch_mesh((1, 1, 1),
+                                            devices=jax.devices()[:1])
+        return _MESH
+
+
+def lane_runner(family: SpecFamily):
+    """The masked batched runner for a family (get-or-create)."""
+    key = (family.rule.notation, family.topology.value, family.backend)
+    mesh = _lane_mesh()
+    with _RUNNER_LOCK:
+        runner = _RUNNERS.get(key)
+        if runner is None:
+            if family.backend == "pallas":
+                runner = batched.make_multi_step_pallas_batched(
+                    mesh, family.rule, family.topology, masked=True)
+            else:
+                runner = batched.make_multi_step_packed_batched(
+                    mesh, family.rule, family.topology, masked=True)
+            _RUNNERS[key] = runner
+        return runner
+
+
+def warm_family(family: SpecFamily,
+                ladder: Tuple[int, ...] = LANE_LADDER) -> int:
+    """Trace/compile the family's runner at every ladder capacity, so no
+    serving-path dispatch ever compiles. Returns the number of shapes
+    exercised. (This is the lane half of the warm start: the engine-spec
+    half — and the persistent-cache wiring — is aot/warmup.py.)"""
+    runner = lane_runner(family)
+    for cap in ladder:
+        zeros = np.zeros((int(cap), family.height, family.wq),
+                         dtype=np.uint32)
+        mask = np.zeros((int(cap),), dtype=np.uint32)
+        # n=1 with an all-dead mask: traces the full loop body, steps
+        # nothing (mask-0 slots pass through bit-identical)
+        runner(zeros, 1, mask)
+    return len(ladder)
+
+
+class Lane:
+    """One batch executor: capacity slots of a family + occupancy."""
+
+    def __init__(self, lane_id: str, family: SpecFamily, capacity: int):
+        self.lane_id = lane_id
+        self.family = family
+        self.capacity = int(capacity)
+        self.slots: List[Optional[str]] = [None] * self.capacity
+        self.state = np.zeros((self.capacity, family.height, family.wq),
+                              dtype=np.uint32)
+        self._runner = lane_runner(family)
+        self.steps_dispatched = 0
+        self.fail_next = False  # test seam: inject one lane crash
+
+    # -- slot surgery (host numpy, never a device dispatch) ------------------
+
+    def live_count(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def place(self, sid: str, words: np.ndarray) -> int:
+        slot = self.free_slot()
+        if slot is None:
+            raise ValueError(f"lane {self.lane_id} is full")
+        self.slots[slot] = sid
+        self.state[slot] = words
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.slots[slot] = None
+        self.state[slot] = 0  # freed slots must not leak grids into dumps
+
+    def read(self, slot: int) -> np.ndarray:
+        return np.array(self.state[slot], copy=True)
+
+    def write(self, slot: int, words: np.ndarray) -> None:
+        self.state[slot] = words
+
+    def occupancy_mask(self, live_sids=None) -> np.ndarray:
+        """(capacity,) uint32 — 1 where a slot is occupied (and, when
+        ``live_sids`` is given, a member of it)."""
+        mask = np.zeros((self.capacity,), dtype=np.uint32)
+        for i, sid in enumerate(self.slots):
+            if sid is not None and (live_sids is None or sid in live_sids):
+                mask[i] = 1
+        return mask
+
+    # -- the dispatch --------------------------------------------------------
+
+    def step(self, n: int, mask: np.ndarray) -> None:
+        """Advance masked slots ``n`` generations in one dispatch."""
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError(
+                f"injected lane fault ({self.lane_id})")
+        out = self._runner(self.state, int(n),
+                           np.ascontiguousarray(mask, dtype=np.uint32))
+        # copy=True: np.asarray of a CPU jax.Array is a read-only
+        # zero-copy view that dangles once the device buffer is freed —
+        # slot surgery needs an owned, writable buffer
+        self.state = np.array(out, dtype=np.uint32, copy=True)
+        self.steps_dispatched += int(n)
+
+    def stats(self) -> dict:
+        return {"lane": self.lane_id, "family": self.family.key,
+                "capacity": self.capacity, "live": self.live_count()}
+
+
+class LanePool:
+    """All lanes of one family + the ladder placement/compaction policy.
+
+    Placement: first free slot in lane-creation order. Growth and
+    compaction both route through :meth:`repack` — compute the ideal
+    ladder multiset for the live-session count (greedy from the largest
+    rung), rebuild lanes at those capacities, and re-place every live
+    session. The pool returns the new ``sid -> (lane_id, slot)`` map;
+    the caller (serve/service.py) owns updating Session records.
+    """
+
+    def __init__(self, family: SpecFamily,
+                 ladder: Tuple[int, ...] = LANE_LADDER):
+        if not ladder:
+            raise ValueError("lane ladder cannot be empty")
+        self.family = family
+        self.ladder = tuple(sorted(set(int(c) for c in ladder)))
+        self.lanes: Dict[str, Lane] = {}
+        self._seq = itertools.count(1)
+        self.compactions = 0
+        self.warmed = False
+
+    # -- policy --------------------------------------------------------------
+
+    def plan(self, count: int) -> List[int]:
+        """The ideal capacity multiset for ``count`` live sessions:
+        largest rungs first, one smallest-fitting rung for the tail."""
+        caps: List[int] = []
+        top = self.ladder[-1]
+        remaining = int(count)
+        while remaining >= top:
+            caps.append(top)
+            remaining -= top
+        if remaining > 0:
+            caps.append(min(c for c in self.ladder if c >= remaining))
+        return caps
+
+    def total_capacity(self) -> int:
+        return sum(lane.capacity for lane in self.lanes.values())
+
+    def live_count(self) -> int:
+        return sum(lane.live_count() for lane in self.lanes.values())
+
+    def warm(self) -> None:
+        if not self.warmed:
+            warm_family(self.family, self.ladder)
+            self.warmed = True
+
+    # -- placement -----------------------------------------------------------
+
+    def _new_lane(self, capacity: int) -> Lane:
+        lane_id = f"{self.family.key}#{next(self._seq)}"
+        lane = Lane(lane_id, self.family, capacity)
+        self.lanes[lane_id] = lane
+        return lane
+
+    def place(self, sid: str, words: np.ndarray) -> Tuple[str, int, dict]:
+        """Claim a slot for ``sid``; returns (lane_id, slot, moves) where
+        ``moves`` maps any *other* sessions a growth-repack relocated to
+        their new (lane_id, slot)."""
+        for lane in self.lanes.values():
+            slot = lane.free_slot()
+            if slot is not None:
+                lane.slots[slot] = sid
+                lane.state[slot] = words
+                return lane.lane_id, slot, {}
+        # no free slot anywhere: grow through a repack sized for +1 so
+        # growth reuses the same warm shapes compaction does
+        moves = self.repack(self.live_count() + 1)
+        for lane in self.lanes.values():
+            slot = lane.free_slot()
+            if slot is not None:
+                lane.slots[slot] = sid
+                lane.state[slot] = words
+                return lane.lane_id, slot, moves
+        raise RuntimeError(
+            f"repack for {self.live_count() + 1} sessions left no free "
+            f"slot (ladder {self.ladder})")
+
+    def release(self, lane_id: str, slot: int) -> None:
+        self.lanes[lane_id].release(slot)
+
+    def compact(self) -> dict:
+        """Repack iff the ideal multiset is strictly smaller than what
+        is allocated. Returns the relocation map (empty = no-op)."""
+        live = self.live_count()
+        ideal = self.plan(live)
+        if sum(ideal) >= self.total_capacity() and len(
+                ideal) >= len(self.lanes):
+            return {}
+        return self.repack(live)
+
+    def repack(self, target_count: int) -> dict:
+        """Rebuild lanes at ``plan(target_count)`` capacities and re-place
+        every live session (deterministic order: old lane creation order,
+        then slot order). Host-side copies only — the new shapes come
+        from the ladder, so every executable is already warm."""
+        entries: List[Tuple[str, np.ndarray]] = []
+        for lane in self.lanes.values():
+            for slot, sid in enumerate(lane.slots):
+                if sid is not None:
+                    entries.append((sid, lane.read(slot)))
+        if target_count < len(entries):
+            target_count = len(entries)
+        self.lanes.clear()
+        moves: Dict[str, Tuple[str, int]] = {}
+        for cap in self.plan(target_count):
+            self._new_lane(cap)
+        lanes = list(self.lanes.values())
+        li = 0
+        for sid, words in entries:
+            while lanes[li].free_slot() is None:
+                li += 1
+            slot = lanes[li].place(sid, words)
+            moves[sid] = (lanes[li].lane_id, slot)
+        self.compactions += 1
+        return moves
+
+    def stats(self) -> List[dict]:
+        return [lane.stats() for lane in self.lanes.values()]

@@ -82,3 +82,51 @@ def test_batched_pallas_band_bit_identity(mesh_shape, B, H, g, topology):
         np.testing.assert_array_equal(
             np.asarray(out[i]), np.asarray(want),
             err_msg=f"universe {i} diverged on mesh {mesh_shape}")
+
+
+def test_batched_masked_freezes_slots():
+    """masked=True: the occupancy mask is a *runtime operand* — mask-0
+    slots pass through bit-identical while mask-1 slots advance. This is
+    the invariant the serving lanes (serve/lanes.py) multiplex sessions
+    on: claiming/freeing a slot never changes the jit signature."""
+    rng = np.random.default_rng(9)
+    B = 4
+    grids = rng.integers(0, 2, size=(B, 16, 64), dtype=np.uint8)
+    packed = jnp.stack([bitpack.pack(jnp.asarray(g)) for g in grids])
+    mesh = batched.make_batch_mesh((1, 1, 1), devices=jax.devices()[:1])
+    run = batched.make_multi_step_packed_batched(
+        mesh, CONWAY, Topology.TORUS, masked=True)
+    mask = np.array([1, 0, 1, 0], dtype=np.uint32)
+    out = run(np.asarray(packed), 4, mask)
+    for i in range(B):
+        want = (multi_step_packed(packed[i], 4, rule=CONWAY,
+                                  topology=Topology.TORUS)
+                if mask[i] else packed[i])
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), np.asarray(want),
+            err_msg=f"slot {i} (mask {mask[i]})")
+    # flipping the mask re-dispatches the same executable (operand, not
+    # signature): all-frozen passes the whole batch through untouched
+    out2 = run(np.asarray(packed), 4, np.zeros(B, dtype=np.uint32))
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(packed))
+
+
+def test_batched_pallas_masked_freezes_slots():
+    """The masked contract through the native-kernel DP runner
+    (interpret mode): the select is applied per chunk, after the kernel,
+    so frozen slots never drift even though their bands still flow
+    through the DMA pipeline."""
+    rng = np.random.default_rng(10)
+    B = 2
+    grids = rng.integers(0, 2, size=(B, 16, 64), dtype=np.uint8)
+    packed = jnp.stack([bitpack.pack(jnp.asarray(g)) for g in grids])
+    mesh = batched.make_batch_mesh((1, 1, 1), devices=jax.devices()[:1])
+    run = batched.make_multi_step_pallas_batched(
+        mesh, CONWAY, Topology.TORUS, gens_per_exchange=2, masked=True,
+        interpret=True)
+    mask = np.array([0, 1], dtype=np.uint32)
+    out = run(np.asarray(packed), 1, mask)  # one chunk = 2 generations
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(packed[0]))
+    want = multi_step_packed(packed[1], 2, rule=CONWAY,
+                             topology=Topology.TORUS)
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(want))

@@ -137,3 +137,54 @@ def test_device_gauges_flow_through_exporter():
     assert 'goltpu_hbm_bytes_limit{device="3",platform="tpu"} 17179869184' \
         in text
     assert "goltpu_device_samples 1" in text
+
+
+def test_healthz_info_hook_is_late_bound():
+    """set_health_info installs/replaces the /healthz hook on a RUNNING
+    server (the serve layer starts the exporter before the session
+    service exists); the handler calls it per request, and a broken hook
+    degrades to ok+info_error instead of killing the liveness probe."""
+    reg = MetricsRegistry()
+    counts = {"sessions": {"live": 1}, "lanes": 2}
+    with MetricsServer(0, registry=reg, host="127.0.0.1") as srv:
+        url = f"http://127.0.0.1:{srv.port}/healthz"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        srv.set_health_info(lambda: counts)
+        with urllib.request.urlopen(url, timeout=5) as r:
+            got = json.loads(r.read())
+        assert got["ok"] is True and got["lanes"] == 2
+        counts["lanes"] = 7  # per-request call, not a startup snapshot
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert json.loads(r.read())["lanes"] == 7
+
+        def boom() -> dict:
+            raise RuntimeError("hook broke")
+
+        srv.set_health_info(boom)
+        with urllib.request.urlopen(url, timeout=5) as r:
+            got = json.loads(r.read())
+        assert got["ok"] is True and got["info_error"] is True
+
+
+def test_histogram_custom_buckets_render_and_conflict():
+    """Custom bucket boundaries (the admission queue-wait seconds, not
+    the step-latency decades) reach the exposition; a later registration
+    with CONFLICTING explicit buckets is a hard error, while buckets=None
+    composes with whatever the instrument already has."""
+    reg = MetricsRegistry()
+    h = reg.histogram("queue_wait_seconds", "waits",
+                      buckets=(0.5, 5.0, 300.0))
+    h.observe(2.0, kind="q")
+    text = render_prometheus(reg.snapshot())
+    assert 'goltpu_queue_wait_seconds_bucket{kind="q",le="0.5"} 0' in text
+    assert 'goltpu_queue_wait_seconds_bucket{kind="q",le="5"} 1' in text
+    assert 'goltpu_queue_wait_seconds_bucket{kind="q",le="300"} 1' in text
+    assert 'goltpu_queue_wait_seconds_bucket{kind="q",le="+Inf"} 1' in text
+    assert 'goltpu_queue_wait_seconds_count{kind="q"} 1' in text
+    assert reg.histogram("queue_wait_seconds") is h  # None = don't-care
+    try:
+        reg.histogram("queue_wait_seconds", buckets=(1.0, 2.0))
+        raise AssertionError("conflicting buckets must be refused")
+    except ValueError as exc:
+        assert "buckets" in str(exc)

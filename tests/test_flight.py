@@ -203,3 +203,47 @@ def test_load_dump_tolerates_blank_and_unknown_lines(tmp_path):
     d = load_dump(str(path))
     assert d["flight"]["reason"] == "x"
     assert d["span"] == []
+
+
+def test_signal_chain_preserves_both_handlers(tmp_path):
+    """Regression: a hook chained on TOP of an armed flight recorder
+    must fire AND still reach the recorder's dump — and uninstalling in
+    reverse order leaves the original disposition untouched. (The bug
+    class: a second SIGTERM installer silently dropping the first.)"""
+    hits = []
+    prev = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)  # survivable base
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"))
+    fr.install(signals=True)
+    unchain = flight_lib.chain_signal_handler(
+        signal.SIGTERM, lambda signum, frame: hits.append(signum))
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert hits == [signal.SIGTERM], "the top hook must fire"
+        assert fr.dumps == 1, "the chained recorder must still dump"
+        assert fr.last_dump_reason == "signal SIGTERM"
+        assert os.path.exists(str(tmp_path / "f.jsonl"))
+    finally:
+        unchain()
+        fr.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_recorder_chains_onto_graceful_handler(tmp_path):
+    """The serve process shape (serve/frontend.py main): the graceful
+    stop handler installs FIRST, the recorder arms second — one SIGTERM
+    must both dump the tape and request the clean shutdown."""
+    stopped = []
+    prev = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, lambda s, f: stopped.append(s))
+    fr = FlightRecorder(str(tmp_path / "f.jsonl"))
+    fr.install(signals=True)
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert fr.dumps == 1
+        assert stopped == [signal.SIGTERM], \
+            "arming the recorder must not drop the graceful handler"
+    finally:
+        fr.uninstall()
+        signal.signal(signal.SIGTERM, prev)

@@ -1,0 +1,508 @@
+"""Serving layer (serve/): thousands of sessions on a handful of lanes.
+
+The claims under test, per the serving contract (README "Serving"):
+
+- **bit-identity** — a session multiplexed onto shared masked lanes,
+  through divergent step cursors, ladder compaction, checkpoint/resume,
+  and lane-crash recovery, always equals a dedicated oracle run of its
+  own seed (tests/oracle.py);
+- **zero post-warm retraces** — the capacity ladder is a closed set, so
+  create/close/compaction churn never compiles (retrace_budget(0));
+- **admission** — a faked exhausted device (the DeviceSampler backend
+  seam) provably rejects/queues creates, and frees drain the queue;
+- **observability** — per-tenant ``goltpu_session_steps_total`` and the
+  queue-depth gauge reach the exposition, /healthz carries live counts.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.analysis.sanitizers import retrace_budget
+from gameoflifewithactors_tpu.models.generations import parse_any
+from gameoflifewithactors_tpu.obs.device import DeviceSampler
+from gameoflifewithactors_tpu.obs.exporter import render_prometheus
+from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.resilience.supervisor import RestartPolicy
+from gameoflifewithactors_tpu.serve import (
+    AdmissionController,
+    AdmissionRejected,
+    LanePool,
+    SessionService,
+    SpecFamily,
+    decode_words,
+    encode_words,
+)
+from gameoflifewithactors_tpu.serve.frontend import SessionFrontend
+from gameoflifewithactors_tpu.serve.session import Session
+
+from .oracle import numpy_run
+
+SPEC = {"rule": "B3/S23", "height": 16, "width": 32, "topology": "torus"}
+FAMILIES = (
+    {"rule": "B3/S23", "height": 32, "width": 32, "topology": "torus"},
+    {"rule": "B36/S23", "height": 32, "width": 32, "topology": "torus"},
+    {"rule": "B3/S23", "height": 16, "width": 32, "topology": "dead"},
+)
+FILL = 0.35
+
+
+def expected_grid(spec: dict, rng_seed: int, gens: int,
+                  fill: float = FILL) -> np.ndarray:
+    """The dedicated-engine oracle: same seeding contract as
+    SessionService._seed_words, evolved by the NumPy reference."""
+    h, w = spec["height"], spec["width"]
+    seed = (np.random.default_rng(rng_seed).random((h, w))
+            < fill).astype(np.uint8)
+    return numpy_run(seed, parse_any(spec["rule"]),
+                     Topology(spec.get("topology", "torus")), gens)
+
+
+def make_service(ladder=(1, 2, 4), *, admission_kw=None, **kw):
+    reg = MetricsRegistry()
+    adm = AdmissionController(registry=reg, **(admission_kw or {}))
+    return SessionService(ladder=ladder, registry=reg, admission=adm,
+                          sleep_fn=lambda s: None, **kw), reg
+
+
+# -- units --------------------------------------------------------------------
+
+
+def test_session_lifecycle_enforced():
+    s = Session(sid="s1", tenant="t", family_key="k", spec=dict(SPEC))
+    assert s.state == "pending" and not s.live
+    s.transition("packed")
+    s.transition("running")
+    assert s.live
+    s.transition("closed")
+    with pytest.raises(ValueError, match="illegal transition"):
+        s.transition("running")
+
+
+def test_spec_family_rejects_non_lane_specs():
+    with pytest.raises(ValueError, match="binary life-like"):
+        SpecFamily("brain", 32, 32)  # Generations rule: not a lane family
+    with pytest.raises(ValueError, match="mesh"):
+        SpecFamily.from_spec(dict(SPEC, mesh="auto"))
+    with pytest.raises(ValueError):
+        SpecFamily("B3/S23", 32, 33)  # width % 32
+    # 'auto' resolves to the packed lane runner; shape spelling accepted
+    fam = SpecFamily.from_spec({"rule": "B3/S23", "shape": [16, 32],
+                                "backend": "auto"})
+    assert fam.backend == "packed" and fam.slot_bytes() == 2 * 16 * 1 * 4
+
+
+def test_lane_ladder_plan():
+    pool = LanePool(SpecFamily.from_spec(SPEC), ladder=(1, 2, 4))
+    assert pool.plan(0) == []
+    assert pool.plan(1) == [1]
+    assert pool.plan(3) == [4]
+    assert pool.plan(5) == [4, 1]
+    assert pool.plan(11) == [4, 4, 4]
+
+
+def test_wire_codec_roundtrip():
+    words = np.random.default_rng(5).integers(
+        0, 2 ** 32, size=(16, 1), dtype=np.uint32)
+    assert np.array_equal(decode_words(encode_words(words), 16, 1), words)
+    with pytest.raises(ValueError, match="expected"):
+        decode_words("abcd", 16, 1)
+
+
+# -- bit-identity under multiplexing ------------------------------------------
+
+
+def test_property_create_close_step_matches_oracle():
+    """Random create/close/step interleavings: every surviving session is
+    bit-identical to a dedicated engine of its own seed — packing,
+    divergent cursors, and compaction are semantically invisible."""
+    svc, _ = make_service(ladder=(1, 2, 4))
+    rng = np.random.default_rng(1234)
+    live = {}  # sid -> (spec_idx as rng_seed, gens)
+    next_seed = 0
+    for _ in range(60):
+        op = rng.choice(["create", "step", "close"],
+                        p=[0.4, 0.45, 0.15] if live else [1, 0, 0])
+        if op == "create":
+            info = svc.create("prop", SPEC, fill=FILL, rng_seed=next_seed)
+            live[info["sid"]] = [next_seed, 0]
+            next_seed += 1
+        elif op == "step":
+            sid = rng.choice(sorted(live))
+            n = int(rng.integers(1, 4))
+            svc.step(sid, n)
+            live[sid][1] += n
+        else:
+            sid = rng.choice(sorted(live))
+            svc.close(sid)
+            del live[sid]
+    assert live, "the op mix must leave survivors to verify"
+    for sid, (seed, gens) in live.items():
+        np.testing.assert_array_equal(
+            svc.grid(sid), expected_grid(SPEC, seed, gens),
+            err_msg=f"{sid} diverged after {gens} gens")
+
+
+def test_divergent_cursors_on_one_lane():
+    """Sessions sharing a lane step different amounts per call; the
+    min-positive-debt pump with the occupancy mask must keep each
+    trajectory exact (including a zero-step idler)."""
+    svc, _ = make_service(ladder=(4,))
+    sids = [svc.create("t", SPEC, fill=FILL, rng_seed=i)["sid"]
+            for i in range(4)]
+    plan = [5, 2, 7, 0]
+    for sid, n in zip(sids, plan):
+        if n:
+            svc.step(sid, n)
+    for sid, n in zip(sids, plan):
+        np.testing.assert_array_equal(
+            svc.grid(sid), expected_grid(SPEC, sids.index(sid), n))
+
+
+# -- retrace discipline -------------------------------------------------------
+
+
+def test_ladder_churn_zero_postwarm_retraces():
+    """After warm(), arbitrary create/step/close churn — including the
+    growth and compaction repacks that move sessions across ladder
+    shapes — must not pay a single real XLA compile."""
+    svc, _ = make_service(ladder=(1, 2, 4))
+    svc.warm(SPEC)
+    with retrace_budget(0, context="serve ladder churn"):
+        sids = [svc.create("t", SPEC, fill=FILL, rng_seed=i)["sid"]
+                for i in range(7)]  # grows 1 -> 2 -> 4 -> 4+4
+        for sid in sids:
+            svc.step(sid, 2)
+        for sid in sids[::2]:  # compaction back down the ladder
+            svc.close(sid)
+        for sid in sids[1::2]:
+            svc.step(sid, 3)
+    pool = svc.pools[SpecFamily.from_spec(SPEC).key]
+    assert pool.compactions > 0, "the churn must actually repack"
+
+
+# -- admission ----------------------------------------------------------------
+
+
+def fake_device(bytes_in_use: int, bytes_limit: int):
+    return [{"device": "0", "platform": "tpu",
+             "bytes_in_use": bytes_in_use, "peak_bytes_in_use": bytes_in_use,
+             "bytes_limit": bytes_limit}]
+
+
+def test_admission_rejects_on_fake_hbm_exhaustion():
+    """The acceptance scenario: hbm gauges (fed through the real
+    DeviceSampler backend seam) report an exhausted device; with no
+    queue room the create is refused outright, and the decision lands on
+    the exposition."""
+    svc, reg = make_service(admission_kw={"queue_limit": 0})
+    DeviceSampler(registry=reg,
+                  backend=lambda: fake_device(2 ** 30 - 10, 2 ** 30)
+                  ).sample_once()
+    with pytest.raises(AdmissionRejected, match="over HBM budget"):
+        svc.create("t", SPEC, fill=FILL)
+    text = render_prometheus(reg.snapshot())
+    assert ('goltpu_session_admission_total{decision="reject",tenant="t"} 1'
+            in text)
+    assert svc.counts()["sessions"]["total"] == 0
+
+
+def test_admission_queue_then_drain_on_free():
+    svc, reg = make_service(admission_kw={"queue_limit": 4})
+    state = {"in_use": 2 ** 30 - 10}
+    sampler = DeviceSampler(
+        registry=reg,
+        backend=lambda: fake_device(state["in_use"], 2 ** 30))
+    sampler.sample_once()
+    info = svc.create("t", SPEC, fill=FILL, rng_seed=3)
+    assert info["state"] == "pending"
+    assert svc.admission.queue_depth() == 1
+    # debt accrues while parked; applies after admission
+    svc.step(info["sid"], 4)
+    assert svc.info(info["sid"])["state"] == "pending"
+    state["in_use"] = 0  # closes elsewhere freed the memory
+    sampler.sample_once()
+    svc.pump()  # drains the queue into the freed budget
+    svc.pump()  # applies the parked debt
+    assert svc.admission.queue_depth() == 0
+    got = svc.info(info["sid"])
+    assert got["state"] == "running" and got["generation"] == 4
+    np.testing.assert_array_equal(
+        svc.grid(info["sid"]), expected_grid(SPEC, 3, 4))
+    text = render_prometheus(reg.snapshot())
+    # queue waits land in the custom bucket boundaries, not the
+    # step-latency decades
+    assert 'goltpu_session_queue_wait_seconds_bucket' in text
+    assert 'le="300"' in text
+    assert 'goltpu_session_queue_depth 0' in text
+
+
+def test_admission_queue_overflow_rejects():
+    svc, reg = make_service(admission_kw={"queue_limit": 1})
+    DeviceSampler(registry=reg,
+                  backend=lambda: fake_device(2 ** 30, 2 ** 30)).sample_once()
+    assert svc.create("t", SPEC, fill=FILL)["state"] == "pending"
+    with pytest.raises(AdmissionRejected):
+        svc.create("t", SPEC, fill=FILL)
+
+
+def test_admission_permissive_without_limit_gauge():
+    """CPU host-RSS publishes no hbm_bytes_limit: a gauge that does not
+    exist must admit, not refuse, traffic."""
+    svc, _ = make_service()
+    assert svc.create("t", SPEC, fill=FILL)["state"] == "packed"
+
+
+# -- lane recovery ------------------------------------------------------------
+
+
+def test_lane_crash_recovery_bit_identical():
+    """An injected lane fault mid-debt: the lane restores from recovery
+    snapshots, lost generations replay as re-credited debt, and the
+    final grids equal the never-faulted oracle."""
+    svc, reg = make_service(ladder=(4,))
+    sids = [svc.create("t", SPEC, fill=FILL, rng_seed=i)["sid"]
+            for i in range(3)]
+    for sid in sids:
+        svc.step(sid, 3)
+    s0 = svc.store.get(sids[0])
+    lane = svc.pools[s0.family_key].lanes[s0.lane_id]
+    lane.fail_next = True
+    svc.step(sids[0], 5)  # the pump hits the fault, recovers, replays
+    for i, (sid, gens) in enumerate(zip(sids, (8, 3, 3))):
+        assert svc.info(sid)["generation"] == gens
+        np.testing.assert_array_equal(
+            svc.grid(sid), expected_grid(SPEC, i, gens),
+            err_msg=f"session {i} not bit-identical after lane recovery")
+    assert reg.counter("session_lane_recoveries_total").value(
+        family=s0.family_key) == 1
+
+
+def test_lane_circuit_open_evicts_not_wedges():
+    svc, reg = make_service(
+        ladder=(2,), policy=RestartPolicy(max_restarts=2,
+                                          backoff_initial_seconds=0.0))
+    sid = svc.create("t", SPEC, fill=FILL)["sid"]
+    s = svc.store.get(sid)
+    lane = svc.pools[s.family_key].lanes[s.lane_id]
+
+    def always_fails(n, mask):
+        raise RuntimeError("wedged lane")
+
+    lane.step = always_fails
+    svc.step(sid, 1)  # restarts burn the budget, then the circuit opens
+    assert svc.info(sid)["state"] == "evicted"
+    assert reg.counter("session_evictions_total").value(
+        family=s.family_key) == 1
+    # the service is not wedged: fresh creates land on a fresh lane
+    sid2 = svc.create("t", SPEC, fill=FILL, rng_seed=9)["sid"]
+    svc.step(sid2, 2)
+    np.testing.assert_array_equal(svc.grid(sid2),
+                                  expected_grid(SPEC, 9, 2))
+    with pytest.raises(ValueError, match="evicted"):
+        svc.step(sid, 1)
+
+
+# -- checkpoint / resume ------------------------------------------------------
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    ck = str(tmp_path / "sessions.npz")
+    svc, reg = make_service(checkpoint_path=ck,
+                            admission_kw={"queue_limit": 4})
+    sids = [svc.create("t", SPEC, fill=FILL, rng_seed=i)["sid"]
+            for i in range(3)]
+    for i, sid in enumerate(sids):
+        svc.step(sid, 2 + i)
+    # park one more behind a faked full device, with debt outstanding
+    DeviceSampler(registry=reg,
+                  backend=lambda: fake_device(2 ** 30, 2 ** 30)).sample_once()
+    queued = svc.create("t", SPEC, fill=FILL, rng_seed=99)["sid"]
+    svc.step(queued, 6)
+    svc.checkpoint()
+
+    svc2, _ = make_service(checkpoint_path=ck,
+                           admission_kw={"queue_limit": 4})
+    assert svc2.resume() == 4
+    for i, sid in enumerate(sids):
+        info = svc2.info(sid)
+        assert info["generation"] == 2 + i
+        np.testing.assert_array_equal(
+            svc2.grid(sid), expected_grid(SPEC, i, 2 + i))
+    # the parked session resumed pending with its debt intact; a pump
+    # cycle admits it (no limit gauge in the fresh registry) and pays
+    assert svc2.info(queued)["state"] == "pending"
+    assert svc2.info(queued)["pending_steps"] == 6
+    svc2.pump()
+    svc2.pump()
+    np.testing.assert_array_equal(svc2.grid(queued),
+                                  expected_grid(SPEC, 99, 6))
+
+
+def test_resume_requires_empty_service(tmp_path):
+    ck = str(tmp_path / "s.npz")
+    svc, _ = make_service(checkpoint_path=ck)
+    svc.create("t", SPEC, fill=FILL)
+    svc.checkpoint()
+    with pytest.raises(RuntimeError, match="empty"):
+        svc.resume()
+
+
+# -- the acceptance e2e -------------------------------------------------------
+
+
+def test_e2e_thousand_sessions_few_lanes():
+    """ISSUE-12 acceptance: >= 1000 concurrent sessions across >= 3 spec
+    families on <= 8 lanes, every one bit-identical to its dedicated
+    oracle, zero post-warm retraces, per-tenant step counters and the
+    queue-depth gauge on the exposition."""
+    svc, reg = make_service(ladder=(1, 8, 64, 256))
+    for f in FAMILIES:
+        svc.warm(f)
+    N = 1000
+    sids, gens = [], []
+    with retrace_budget(0, context="serve e2e"):
+        for i in range(N):
+            sids.append(svc.create(f"tenant{i % 4}", FAMILIES[i % 3],
+                                   fill=FILL, rng_seed=i)["sid"])
+        for i, sid in enumerate(sids):
+            n = 1 + i % 4
+            svc.step(sid, n, pump=False)  # credit debt; one pump below
+            gens.append(n)
+        svc.pump()
+    lanes = svc.lane_stats()
+    assert len(lanes) <= 8, f"{len(lanes)} lanes for {N} sessions"
+    assert len({ln["family"] for ln in lanes}) == 3
+    assert svc.counts()["sessions"]["live"] == N
+    for i, sid in enumerate(sids):
+        assert np.array_equal(
+            svc.grid(sid), expected_grid(FAMILIES[i % 3], i, gens[i])), \
+            f"session {i} diverged from its oracle"
+    text = render_prometheus(reg.snapshot())
+    for t in range(4):
+        line = next(ln for ln in text.splitlines() if ln.startswith(
+            f'goltpu_session_steps_total{{tenant="tenant{t}"}}'))
+        assert float(line.split()[-1]) > 0
+    assert "goltpu_session_queue_depth 0" in text
+    assert 'goltpu_sessions_live{tenant="tenant0"} 250' in text
+
+
+# -- the HTTP frontend --------------------------------------------------------
+
+
+def _req(port, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            raw = r.read()
+            if r.headers.get("Content-Type", "").startswith(
+                    "application/json"):
+                return r.status, json.loads(raw)
+            return r.status, raw.decode()
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_frontend_http_roundtrip(tmp_path):
+    svc, _ = make_service(checkpoint_path=str(tmp_path / "s.npz"))
+    with SessionFrontend(svc, 0) as fe:
+        code, info = _req(fe.port, "POST", "/sessions", {
+            "tenant": "acme", "spec": SPEC, "fill": FILL, "rng_seed": 7})
+        assert code == 201 and info["state"] == "packed"
+        sid = info["sid"]
+        code, info = _req(fe.port, "POST", f"/sessions/{sid}/step",
+                          {"n": 5})
+        assert code == 200 and info["generation"] == 5
+        code, g = _req(fe.port, "GET", f"/sessions/{sid}/grid")
+        assert code == 200 and g["encoding"] == "packed_le_u32_hex"
+        words = decode_words(g["cells_hex"], g["height"], g["width"] // 32)
+        from gameoflifewithactors_tpu.ops import bitpack
+
+        np.testing.assert_array_equal(bitpack.unpack_np(words),
+                                      expected_grid(SPEC, 7, 5))
+        code, h = _req(fe.port, "GET", "/healthz")
+        assert code == 200 and h["ok"] and h["sessions"]["live"] == 1
+        code, text = _req(fe.port, "GET", "/metrics")
+        assert code == 200
+        assert 'goltpu_session_steps_total{tenant="acme"} 5' in text
+        code, ck = _req(fe.port, "POST", "/admin/checkpoint")
+        assert code == 200 and ck["path"].endswith("s.npz")
+        # error mapping: 404 unknown sid, 400 bad payload
+        assert _req(fe.port, "GET", "/sessions/ghost")[0] == 404
+        assert _req(fe.port, "POST", "/sessions",
+                    {"spec": {"rule": "brain", "height": 16,
+                              "width": 32}})[0] == 400
+        code, info = _req(fe.port, "DELETE", f"/sessions/{sid}")
+        assert code == 200 and info["state"] == "closed"
+
+    # the checkpoint written over HTTP resumes a fresh service
+    svc2, _ = make_service(checkpoint_path=str(tmp_path / "s.npz"))
+    assert svc2.resume() == 1
+    np.testing.assert_array_equal(svc2.grid(sid), expected_grid(SPEC, 7, 5))
+
+
+def test_frontend_maps_admission_reject_to_429():
+    svc, reg = make_service(admission_kw={"queue_limit": 0})
+    DeviceSampler(registry=reg,
+                  backend=lambda: fake_device(2 ** 30, 2 ** 30)).sample_once()
+    with SessionFrontend(svc, 0) as fe:
+        code, err = _req(fe.port, "POST", "/sessions",
+                         {"spec": SPEC, "fill": FILL})
+        assert code == 429 and "HBM" in err["error"]
+
+
+# -- manifest lane entries ----------------------------------------------------
+
+
+def test_manifest_lane_entries_load_and_validate(tmp_path):
+    from gameoflifewithactors_tpu.aot.warmup import (
+        load_manifest, load_manifest_entries)
+
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps([
+        {"rule": "B3/S23", "shape": [16, 32], "backend": "packed",
+         "lanes": [1, 2]},
+        {"rule": "B36/S23", "shape": [16, 32], "backend": "packed"},
+    ]))
+    entries = load_manifest_entries(str(path))
+    assert entries[0][1] == {"lanes": [1, 2]}
+    assert entries[1][1] == {}
+    # the extras never reach EngineSpec (which rejects unknown keys)
+    assert load_manifest(str(path))[0].rule == "B3/S23"
+    path.write_text(json.dumps(
+        [{"rule": "B3/S23", "shape": [16, 32], "lanes": [0]}]))
+    with pytest.raises(ValueError, match="positive batch capacities"):
+        load_manifest_entries(str(path))
+
+
+def test_warmup_spec_warms_lane_ladder(tmp_path):
+    from gameoflifewithactors_tpu.aot.spec import EngineSpec
+    from gameoflifewithactors_tpu.aot.warmup import warmup_spec
+
+    spec = EngineSpec.from_dict({"rule": "B3/S23", "shape": [16, 32],
+                                 "backend": "packed"})
+    row = warmup_spec(spec, aot=False, lanes=[1, 2])
+    assert row["lanes"]["capacities"] == [1, 2]
+    assert row["lanes"]["status"].startswith("warmed 2 capacities")
+    # a lane-warmed ladder serves a fresh service with zero compiles
+    svc, _ = make_service(ladder=(1, 2), warm_on_first_use=False)
+    with retrace_budget(0, context="manifest-warmed ladder"):
+        sid = svc.create("t", SPEC, fill=FILL, rng_seed=1)["sid"]
+        svc.step(sid, 2)
+    np.testing.assert_array_equal(svc.grid(sid), expected_grid(SPEC, 1, 2))
+
+
+def test_warmup_reports_unsupported_lane_family():
+    from gameoflifewithactors_tpu.aot.spec import EngineSpec
+    from gameoflifewithactors_tpu.aot.warmup import warmup_spec
+
+    spec = EngineSpec.from_dict({"rule": "brain", "shape": [16, 32],
+                                 "backend": "packed"})
+    row = warmup_spec(spec, aot=False, lanes=[1])
+    assert row["lanes"]["status"].startswith("unsupported:")
