@@ -44,7 +44,13 @@ _lib = None
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
-        lib = ctypes.CDLL(str(build()))
+        try:
+            lib = ctypes.CDLL(str(build()))
+        except OSError:
+            # a prebuilt .so from another toolchain (newer libstdc++,
+            # different ABI) fails dlopen even though it is "fresh" by
+            # mtime — rebuild from source against this machine's runtime
+            lib = ctypes.CDLL(str(build(force=True)))
         lib.actor_gol_run.restype = ctypes.c_double
         lib.actor_gol_run.argtypes = [
             ctypes.c_int, ctypes.c_int,                       # h, w

@@ -19,6 +19,12 @@ multi-controller JAX needs:
   materialising only its own shards).
 - :func:`gather_global` — the inverse, for snapshot/checkpoint/render on
   multi-host: an allgather that returns the full array on every process.
+- :func:`local_shards` — this process's contribution to a sharded
+  checkpoint: (global_index, host_data) for every addressable shard,
+  deduplicated, so each process persists only what its devices own
+  (utils/checkpoint.py sharded-v2 format; no host ever pays O(grid)).
+- :func:`shutdown` — idempotent teardown of the distributed runtime, so
+  an elastic worker can leave the fleet cleanly before exiting.
 
 Proven end-to-end in tests/test_multihost.py: N real OS processes form
 the distributed system over localhost, step a torus-sharded grid with
@@ -28,7 +34,7 @@ bit-identical to the single-device engine.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -40,6 +46,8 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    initialization_timeout: Optional[float] = None,
 ) -> None:
     """Bring up the multi-controller runtime (idempotent).
 
@@ -48,16 +56,51 @@ def initialize(
     values serve CPU rigs and tests. Safe to call twice — a second call is
     a no-op instead of the RuntimeError jax raises. (The check must not
     touch ``jax.process_count()``: that would initialise the XLA backend,
-    which is exactly what must not happen before the handshake.)"""
+    which is exactly what must not happen before the handshake.)
+
+    ``initialization_timeout`` bounds the coordinator handshake — the
+    elastic runtime passes a finite value so a fleet whose coordinator
+    died during relaunch errors out instead of waiting forever."""
+    import os
+
     from jax._src import distributed as _dist
 
     if _dist.global_state.client is not None:
         return
+    # CPU rigs need an explicit cross-process collectives backend: the
+    # default CPU client refuses multi-process computations outright
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"), and the env var alone is not honored on this jaxlib —
+    # the config must be set in-process before the backend exists. On
+    # TPU this never fires (collectives ride ICI/DCN natively).
+    platforms = (getattr(jax.config, "jax_platforms", None)
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" in str(platforms):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — unknown option on other jaxlibs
+            pass
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = int(initialization_timeout)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
+
+
+def shutdown() -> None:
+    """Tear the distributed runtime down (idempotent — a no-op when
+    :func:`initialize` never ran or already shut down). An elastic
+    worker that detected peer loss calls this on its way out so the
+    coordination service is not left waiting on a zombie client."""
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is None:
+        return
+    jax.distributed.shutdown()
 
 
 def global_mesh(shape: Optional[Tuple[int, int]] = None,
@@ -99,6 +142,27 @@ def put_global_grid(grid: np.ndarray, mesh: Mesh,
         sharding = grid_sharding(mesh)
     return jax.make_array_from_callback(grid.shape, sharding,
                                         lambda idx: grid[idx])
+
+
+def local_shards(arr: jax.Array) -> List[Tuple[tuple, np.ndarray]]:
+    """``[(global_index, host_data), ...]`` for every shard this
+    process's devices own — the per-process write set of a sharded
+    checkpoint (utils/checkpoint.py ``write_shards``).
+
+    Replicated axes make several devices hold the same global index;
+    those duplicates are dropped so the union across processes tiles the
+    global array exactly once (what ``commit_manifest`` verifies). Each
+    shard moves device→host locally; nothing crosses the interconnect."""
+    out: List[Tuple[tuple, np.ndarray]] = []
+    seen = set()
+    for sh in arr.addressable_shards:
+        key = tuple(sl.indices(dim)[:2]
+                    for sl, dim in zip(sh.index, arr.shape))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((sh.index, np.asarray(sh.data)))
+    return out
 
 
 def gather_global(arr: jax.Array) -> np.ndarray:

@@ -8,14 +8,22 @@ unlike Akka's state-losing restart), :class:`FaultPlan` makes fault
 campaigns seeded and replayable, and ``worker.py`` is the subprocess
 body the fleet driver (``scripts/soak.py``) launches, kills, and
 resumes.
+
+``distributed.py`` extends the same contract across processes (ISSUE
+14): an elastic fleet of multi-controller JAX workers with heartbeat +
+barrier failure detection, sharded verified checkpoints, and
+teardown-rebuild-replay recovery — driven by ``scripts/chaos_multihost.py``.
+It is deliberately not imported here: workers re-exec this package and
+must not pay for (or wedge on) anything they don't use.
 """
 
-from .faultplan import (ALL_KINDS, FaultEvent, FaultPlan, apply_fault,
-                        induce_retrace, induce_stall)
+from .faultplan import (ALL_KINDS, DRIVER_KINDS, FaultEvent, FaultPlan,
+                        apply_fault, induce_retrace, induce_stall)
 from .supervisor import CircuitOpenError, RestartPolicy, Supervisor
 
 __all__ = [
     "ALL_KINDS",
+    "DRIVER_KINDS",
     "CircuitOpenError",
     "FaultEvent",
     "FaultPlan",

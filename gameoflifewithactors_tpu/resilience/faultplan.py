@@ -25,6 +25,14 @@ survive (SURVEY.md §6, ISSUE 11):
 - ``kill`` — SIGKILL of the worker process. Never applied in-process:
   the fleet driver (scripts/soak.py) owns it, the worker only sees the
   resume.
+- ``process_kill`` / ``process_preempt`` / ``checkpoint_corrupt`` —
+  the *distributed* fault kinds (ISSUE 14), also driver-level: SIGKILL
+  a named peer of a multi-host fleet, SIGTERM it with a grace window
+  (finish chunk, checkpoint, exit "preempted"), or flip bytes in a
+  shard of the newest committed sharded checkpoint so the next restore
+  must refuse it and fall back a generation. The elastic fleet driver
+  (resilience/distributed.py, scripts/chaos_multihost.py) consumes
+  them; in-process appliers refuse them by construction.
 
 Faults address workers by index and fire at a generation threshold, so
 the schedule is defined in simulation time, not wall time — the only
@@ -40,12 +48,16 @@ import random
 import time
 from typing import List, Optional, Sequence
 
-# in-process kinds the worker applies between supervised chunks; "kill"
-# is driver-level (the process can hardly SIGKILL-and-resume itself)
+# in-process kinds the worker applies between supervised chunks; driver
+# kinds belong to the fleet driver (a process can hardly
+# SIGKILL-and-resume itself, and checkpoint corruption must land while
+# nobody is mid-write)
 STATE_KINDS = ("corrupt_region", "drop_region", "corrupt_shard",
                "drop_shard")
 PROCESS_KINDS = ("stall", "retrace", "kill")
-ALL_KINDS = STATE_KINDS + PROCESS_KINDS
+DRIVER_KINDS = ("kill", "process_kill", "process_preempt",
+                "checkpoint_corrupt")
+ALL_KINDS = STATE_KINDS + PROCESS_KINDS + DRIVER_KINDS[1:]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,7 +129,16 @@ class FaultPlan:
         if horizon < 8:
             raise ValueError(f"horizon too short to schedule into: {horizon}")
         rng = random.Random(seed)
-        injectable = [k for k in kinds if k != "kill"]
+        # the per-worker random draw stays in-process-only: driver kinds
+        # are scheduled deliberately (ensure_kinds / kill_workers), not
+        # sprayed — a random process_kill of every worker is an outage,
+        # not a campaign
+        injectable = [k for k in kinds if k not in DRIVER_KINDS]
+        if faults_per_worker > 0 and not injectable:
+            raise ValueError(
+                f"no in-process fault kinds in {tuple(kinds)}; pass "
+                "faults_per_worker=0 and schedule driver kinds via "
+                "ensure_kinds")
         lo, hi = max(1, horizon // 4), max(2, (3 * horizon) // 4)
         events: List[FaultEvent] = []
         for w in range(workers):
@@ -156,6 +177,12 @@ def _draw_params(rng: random.Random, kind: str) -> dict:
         if kind == "corrupt_shard":
             p["seed"] = rng.randrange(2 ** 31)
         return p
+    if kind == "process_preempt":
+        # grace window the driver allows between SIGTERM and SIGKILL
+        # escalation — long enough to finish a chunk and checkpoint
+        return {"grace_seconds": round(rng.uniform(5.0, 15.0), 2)}
+    if kind == "checkpoint_corrupt":
+        return {"seed": rng.randrange(2 ** 31)}
     return {}
 
 
@@ -254,4 +281,7 @@ def apply_fault(supervisor, event: FaultEvent, *,
     if kind == "retrace":
         supervisor.inject(kind, lambda e: induce_retrace())
         return kind
-    raise ValueError(f"fault kind {kind!r} is not applicable in-process")
+    raise ValueError(
+        f"fault kind {kind!r} is not applicable in-process"
+        + (" (driver kinds belong to the fleet driver — scripts/soak.py "
+           "or resilience/distributed.py)" if kind in DRIVER_KINDS else ""))

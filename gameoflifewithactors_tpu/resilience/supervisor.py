@@ -120,6 +120,7 @@ class Supervisor:
         self._retraces_attributed = 0
         self._stalls_detected = 0
         self._validator_trips = 0
+        self._checkpoint_fallbacks = 0
         self._circuit_open = False
         self._sentinel = _sanitizers.RetraceSentinel(
             context="supervised run (post-warm)")
@@ -187,6 +188,7 @@ class Supervisor:
                 "retraces_attributed": self._retraces_attributed,
                 "stalls_detected": self._stalls_detected,
                 "validator_trips": self._validator_trips,
+                "checkpoint_fallbacks": self._checkpoint_fallbacks,
                 "circuit_open": self._circuit_open,
             }
 
@@ -267,6 +269,11 @@ class Supervisor:
         return None
 
     def _save_checkpoint(self) -> None:
+        # keep the outgoing checkpoint reachable as <path>.prev: if the
+        # new file later turns out truncated/corrupt (bitrot, torn
+        # write outside our atomic-replace discipline), restore falls
+        # back to it instead of crashing the run
+        ckpt_lib.rotate_previous(self.checkpoint_path)
         ckpt_lib.save(self.coordinator.engine, self.checkpoint_path)
         REGISTRY.counter("supervisor_checkpoints_total",
                          "clean-chunk checkpoints written").inc()
@@ -275,6 +282,28 @@ class Supervisor:
         REGISTRY.gauge("supervisor_generation",
                        "last checkpointed generation"
                        ).set(self.coordinator.generation)
+
+    def _load_restore_point(self):
+        """The last checkpoint, or — when it turns out corrupt or
+        missing — the ``.prev`` generation :meth:`_save_checkpoint`
+        rotated aside. A corrupt checkpoint is a *detected durability
+        fault*, not a crash: the fallback is counted, taped, and the
+        (older) restore point's replay still converges bit-exactly."""
+        try:
+            return ckpt_lib.load_grid(self.checkpoint_path)
+        except (ckpt_lib.CheckpointCorruptError, FileNotFoundError) as exc:
+            prev = str(self.checkpoint_path) + ".prev"
+            REGISTRY.counter(
+                "supervisor_checkpoint_fallbacks_total",
+                "restores that fell back to the .prev checkpoint "
+                "because the newest one was corrupt/missing").inc()
+            obs_flight.note_event(
+                "checkpoint_fallback",
+                {"path": str(self.checkpoint_path),
+                 "error": f"{type(exc).__name__}: {exc}"})
+            with self._lock:
+                self._checkpoint_fallbacks += 1
+            return ckpt_lib.load_grid(prev)
 
     def _restart(self, cause: str, consecutive: int) -> None:
         REGISTRY.counter("supervisor_faults_detected_total",
@@ -297,7 +326,7 @@ class Supervisor:
         delay = self.policy.backoff(consecutive)
         if delay > 0:
             self._sleep(delay)
-        grid, meta = ckpt_lib.load_grid(self.checkpoint_path)
+        grid, meta = self._load_restore_point()
         self.coordinator.engine.set_grid(grid,
                                          generation=meta["generation"])
         self._reset_sentinels()

@@ -86,7 +86,8 @@ def _run_member(spec: dict, member: int, resume: bool,
     events = [plan_lib.FaultEvent.from_dict(e)
               for e in spec.get("events", [])] if member == 0 else []
     events = [e for e in events
-              if e.kind != "kill" and e.at_gen >= resumed_gen]
+              if e.kind not in plan_lib.DRIVER_KINDS
+              and e.at_gen >= resumed_gen]
     applied: List[dict] = []
 
     supervisor = Supervisor(

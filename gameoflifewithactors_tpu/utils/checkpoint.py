@@ -1,4 +1,5 @@
-"""Checkpoint/resume: exact simulation state as one NPZ file (SURVEY.md §6).
+"""Checkpoint/resume: exact simulation state, single-file or sharded
+(SURVEY.md §6).
 
 The reference has no persistence [ABSENT] — a crash loses the universe.
 On TPU the whole simulation state is (packed grid, rule, topology,
@@ -6,6 +7,28 @@ generation), so checkpointing is trivially strong: save is one device→host
 transfer of 1 bit/cell; resume is bit-exact. Files are self-describing so a
 checkpoint can be reloaded onto a different mesh/backend than it was saved
 from (sharding is an execution detail, not simulation state).
+
+Two on-disk families:
+
+- **single-file** (:func:`save` / :func:`load_grid`): one NPZ holding the
+  whole grid — what one host can hold. Internal NPZ versions 1–3 all load.
+- **sharded v2** (:func:`write_shards` / :func:`commit_manifest` /
+  :func:`load_sharded`): a per-generation *directory* where each process
+  writes only the shards its devices own, each with a CRC32, committed
+  atomically by a ``MANIFEST.json`` rename. Restore verifies every
+  checksum and refuses torn or corrupt shards
+  (:class:`CheckpointCorruptError`); :func:`load_latest_verified` falls
+  back generation by generation to the newest *complete* one. This is the
+  multi-host format: no single process ever materialises (or trusts) the
+  whole grid on the write path. Cross-process sequencing (everyone's
+  shards durable before the manifest) is the caller's job — the elastic
+  runtime (resilience/distributed.py) brackets these calls with its
+  deadline-bounded barriers.
+
+Any unreadable checkpoint — truncated zip, corrupt member, bad metadata —
+surfaces as :class:`CheckpointCorruptError` (a ``ValueError``), never a raw
+``zipfile``/``zlib`` traceback, so recovery layers can treat "checkpoint
+rotted" as a routine fall-back-to-previous event instead of a crash.
 """
 
 from __future__ import annotations
@@ -13,8 +36,12 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
+import shutil
+import zipfile
+import zlib
 from pathlib import Path
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 from jax.sharding import Mesh
@@ -26,6 +53,16 @@ from ..ops.stencil import Topology
 
 FORMAT_VERSION = 3  # v3 adds device-layout checkpoints (no dense detour)
 _READABLE_VERSIONS = (1, 2, 3)  # older files load unchanged
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint exists but cannot be trusted: truncated archive,
+    CRC mismatch, missing shard, or undecodable metadata. Subclasses
+    ``ValueError`` so pre-existing ``except ValueError`` call sites keep
+    working; recovery layers catch it specifically and fall back to the
+    previous checkpoint (resilience/supervisor.py,
+    resilience/distributed.py) instead of dying on a raw
+    ``zipfile``/``zlib`` error."""
 
 
 def save(engine: Engine, path: "str | Path") -> Path:
@@ -90,26 +127,49 @@ def save(engine: Engine, path: "str | Path") -> Path:
 
 
 def load_grid(path: "str | Path") -> Tuple[np.ndarray, dict]:
-    """Read (grid, metadata) from a checkpoint without building an engine."""
-    with np.load(Path(path), allow_pickle=False) as z:
-        meta = json.loads(str(z["meta"]))
-        if meta.get("version") not in _READABLE_VERSIONS:
-            raise ValueError(
-                f"unsupported checkpoint version {meta.get('version')!r} in {path}"
-            )
-        h, w = meta["shape"]
-        layout = meta.get("layout")
-        if layout == "packed32":
-            grid = bitpack.unpack_np(np.asarray(z["words"], dtype=np.uint32))[:, :w]
-        elif layout == "genplanes32":
-            from ..ops.packed_generations import unpack_generations_np
+    """Read (grid, metadata) from a checkpoint without building an engine.
 
-            grid = unpack_generations_np(
-                np.asarray(z["planes"], dtype=np.uint32))[:, :w]
-        elif meta.get("multistate"):
-            grid = np.asarray(z["cells"], dtype=np.uint8)
-        else:
-            grid = np.unpackbits(z["bits"], axis=1)[:, :w].astype(np.uint8)
+    A missing file stays ``FileNotFoundError`` (absence is not damage);
+    every other failure mode of an on-disk NPZ — truncated zip, corrupt
+    deflate stream, missing member, undecodable meta — raises
+    :class:`CheckpointCorruptError` so callers can route it to their
+    previous-checkpoint fallback instead of crashing on a ``zipfile``
+    internal."""
+    path = Path(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "meta" not in z:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} has no 'meta' member — not a "
+                    "goltpu checkpoint or a torn write")
+            meta = json.loads(str(z["meta"]))
+            if meta.get("version") not in _READABLE_VERSIONS:
+                raise CheckpointCorruptError(
+                    f"unsupported checkpoint version "
+                    f"{meta.get('version')!r} in {path}")
+            h, w = meta["shape"]
+            layout = meta.get("layout")
+            if layout == "packed32":
+                grid = bitpack.unpack_np(
+                    np.asarray(z["words"], dtype=np.uint32))[:, :w]
+            elif layout == "genplanes32":
+                from ..ops.packed_generations import unpack_generations_np
+
+                grid = unpack_generations_np(
+                    np.asarray(z["planes"], dtype=np.uint32))[:, :w]
+            elif meta.get("multistate"):
+                grid = np.asarray(z["cells"], dtype=np.uint8)
+            else:
+                grid = np.unpackbits(z["bits"], axis=1)[:, :w].astype(np.uint8)
+    except FileNotFoundError:
+        raise
+    except CheckpointCorruptError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, ValueError, KeyError, OSError,
+            EOFError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable "
+            f"({type(exc).__name__}: {exc})") from exc
     return grid, meta
 
 
@@ -130,3 +190,344 @@ def load_engine(
     )
     engine.generation = meta["generation"]
     return engine
+
+
+def rotate_previous(path: "str | Path", suffix: str = ".prev") -> Optional[Path]:
+    """Publish the current checkpoint at ``path`` as ``path + suffix``
+    (atomically) so the next :func:`save` can overwrite ``path`` without
+    destroying the last restore point. Hard-links where the filesystem
+    allows (zero-copy), copies otherwise; a crash at any instant leaves
+    both names pointing at *complete* files. Returns the previous-path,
+    or None when ``path`` does not exist yet."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    prev = path.with_name(path.name + suffix)
+    tmp = path.with_name(f"{path.name}{suffix}.tmp{os.getpid()}")
+    with contextlib.suppress(OSError):
+        os.unlink(tmp)
+    try:
+        os.link(path, tmp)
+    except OSError:  # cross-device / no-hardlink filesystem
+        shutil.copyfile(path, tmp)
+    os.replace(tmp, prev)
+    return prev
+
+
+# -- sharded v2: per-process shards + CRCs under an atomic manifest -----------
+
+SHARDED_FORMAT = "goltpu-sharded"
+SHARDED_FORMAT_VERSION = 2
+MANIFEST_NAME = "MANIFEST.json"
+_GEN_DIR_RE = re.compile(r"^gen-(\d{8})$")
+
+
+def generation_dir(root: "str | Path", generation: int) -> Path:
+    """``<root>/gen-<generation, zero-padded>`` — one directory per
+    checkpointed generation; lexicographic order is generation order."""
+    return Path(root) / f"gen-{int(generation):08d}"
+
+
+def list_generations(root: "str | Path") -> List[Tuple[int, Path]]:
+    """All generation dirs under ``root``, oldest first (committed or
+    not — callers that need trust go through :func:`verify_sharded`)."""
+    root = Path(root)
+    if not root.is_dir():
+        return []
+    out = []
+    for child in root.iterdir():
+        m = _GEN_DIR_RE.match(child.name)
+        if m and child.is_dir():
+            out.append((int(m.group(1)), child))
+    return sorted(out)
+
+
+def _index_to_json(index: Sequence, shape: Sequence[int]) -> List[List[int]]:
+    """Normalise a shard's global index (tuple of slices, possibly with
+    None bounds) to JSON-plain ``[[start, stop], ...]``."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError(f"strided shard index {sl} is not supported")
+        out.append([start, stop])
+    return out
+
+
+def _crc32(data: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(data).tobytes()) & 0xFFFFFFFF
+
+
+def _shard_npz(gen_dir: Path, process_id: int) -> Path:
+    return gen_dir / f"shard-p{int(process_id):04d}.npz"
+
+
+def _shard_sidecar(gen_dir: Path, process_id: int) -> Path:
+    return gen_dir / f"shard-p{int(process_id):04d}.json"
+
+
+def write_shards(
+    gen_dir: "str | Path",
+    process_id: int,
+    shards: Sequence[Tuple[Sequence, np.ndarray]],
+    *,
+    global_shape: Sequence[int],
+    dtype: "str | np.dtype",
+) -> Path:
+    """Write THIS process's shards of one global array: an NPZ with the
+    shard payloads plus a JSON sidecar carrying per-shard CRC32s and
+    global indices. ``shards`` is ``[(global_index, data), ...]`` — for
+    a live ``jax.Array`` use ``parallel.multihost.local_shards``. Both
+    files land via temp + ``os.replace`` (the sidecar last, so a visible
+    sidecar implies a durable payload). Nothing here is a commit point:
+    the generation only becomes loadable when :func:`commit_manifest`
+    publishes the manifest."""
+    gen_dir = Path(gen_dir)
+    gen_dir.mkdir(parents=True, exist_ok=True)
+    dtype = np.dtype(dtype)
+    arrays, entries = {}, []
+    for j, (index, data) in enumerate(shards):
+        data = np.asarray(data)
+        if data.dtype != dtype:
+            raise ValueError(
+                f"shard {j} dtype {data.dtype} != checkpoint dtype {dtype}")
+        key = f"s{j}"
+        arrays[key] = data
+        entries.append({
+            "key": key,
+            "index": _index_to_json(index, global_shape),
+            "shape": list(data.shape),
+            "crc32": _crc32(data),
+        })
+    npz = _shard_npz(gen_dir, process_id)
+    tmp = npz.with_name(f"{npz.name}.tmp{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, npz)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+    sidecar = _shard_sidecar(gen_dir, process_id)
+    tmp = sidecar.with_name(f"{sidecar.name}.tmp{os.getpid()}")
+    tmp.write_text(json.dumps({
+        "process_id": int(process_id),
+        "file": npz.name,
+        "global_shape": list(global_shape),
+        "dtype": dtype.name,
+        "shards": entries,
+    }))
+    os.replace(tmp, sidecar)
+    return npz
+
+
+def commit_manifest(
+    gen_dir: "str | Path",
+    *,
+    meta: dict,
+    num_processes: int,
+) -> Path:
+    """Fold every process's sidecar into one ``MANIFEST.json`` and
+    publish it atomically — THE commit point of a sharded generation.
+    Exactly one process calls this, after a barrier has proven all
+    ``num_processes`` sidecars durable. Verifies the shards jointly
+    tile the global array exactly once (a silent gap would reassemble
+    as zeros — worse than failing)."""
+    gen_dir = Path(gen_dir)
+    sidecars = []
+    for p in range(num_processes):
+        sc = _shard_sidecar(gen_dir, p)
+        try:
+            sidecars.append(json.loads(sc.read_text()))
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f"cannot commit {gen_dir}: process {p}'s shard sidecar "
+                f"is missing ({num_processes} expected)")
+        except (ValueError, OSError) as exc:
+            raise CheckpointCorruptError(
+                f"cannot commit {gen_dir}: sidecar {sc.name} unreadable "
+                f"({exc})") from exc
+    global_shape = tuple(sidecars[0]["global_shape"])
+    dtype = sidecars[0]["dtype"]
+    for sc in sidecars[1:]:
+        if tuple(sc["global_shape"]) != global_shape or sc["dtype"] != dtype:
+            raise CheckpointCorruptError(
+                f"cannot commit {gen_dir}: processes disagree on the "
+                f"global array ({sc['global_shape']}/{sc['dtype']} vs "
+                f"{list(global_shape)}/{dtype})")
+    _check_exact_cover(gen_dir, sidecars, global_shape)
+    manifest = {
+        "format": SHARDED_FORMAT,
+        "version": SHARDED_FORMAT_VERSION,
+        "meta": dict(meta),
+        "global_shape": list(global_shape),
+        "dtype": dtype,
+        "num_processes": int(num_processes),
+        "processes": sidecars,
+    }
+    path = gen_dir / MANIFEST_NAME
+    tmp = path.with_name(f"{path.name}.tmp{os.getpid()}")
+    tmp.write_text(json.dumps(manifest, indent=1))
+    os.replace(tmp, path)
+    return path
+
+
+def _check_exact_cover(gen_dir: Path, sidecars: List[dict],
+                       global_shape: Tuple[int, ...]) -> None:
+    """Every element covered exactly once. Counted with a uint8 mask for
+    grids a host can hold; beyond that (> 2^26 elements) only the total
+    element count is checked — overlap and gap can then only cancel
+    exactly, which a CRC-verified replay would still catch."""
+    total = int(np.prod(global_shape))
+    n_elems = sum(int(np.prod(e["shape"]))
+                  for sc in sidecars for e in sc["shards"])
+    if n_elems != total:
+        raise CheckpointCorruptError(
+            f"cannot commit {gen_dir}: shards cover {n_elems} elements, "
+            f"global array has {total}")
+    if total > (1 << 26):
+        return
+    mask = np.zeros(global_shape, np.uint8)
+    for sc in sidecars:
+        for e in sc["shards"]:
+            mask[tuple(slice(a, b) for a, b in e["index"])] += 1
+    if not (mask == 1).all():
+        raise CheckpointCorruptError(
+            f"cannot commit {gen_dir}: shard indices gap or overlap")
+
+
+def read_manifest(gen_dir: "str | Path") -> dict:
+    """The manifest of a committed generation; an absent manifest means
+    an uncommitted (torn) generation — :class:`CheckpointCorruptError`."""
+    path = Path(gen_dir) / MANIFEST_NAME
+    try:
+        manifest = json.loads(path.read_text())
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{gen_dir} has no {MANIFEST_NAME} — generation was never "
+            "committed (torn write)")
+    except (ValueError, OSError) as exc:
+        raise CheckpointCorruptError(
+            f"{gen_dir}/{MANIFEST_NAME} unreadable ({exc})") from exc
+    if manifest.get("format") != SHARDED_FORMAT:
+        raise CheckpointCorruptError(
+            f"{gen_dir}/{MANIFEST_NAME} is not a {SHARDED_FORMAT} manifest")
+    if manifest.get("version") != SHARDED_FORMAT_VERSION:
+        raise CheckpointCorruptError(
+            f"unsupported sharded checkpoint version "
+            f"{manifest.get('version')!r} in {gen_dir}")
+    return manifest
+
+
+def verify_sharded(gen_dir: "str | Path") -> dict:
+    """Verify a committed generation end to end — manifest present,
+    every shard file readable, every payload matching its manifest CRC32
+    and shape — and return the manifest. Raises
+    :class:`CheckpointCorruptError` naming the first bad shard."""
+    gen_dir = Path(gen_dir)
+    manifest = read_manifest(gen_dir)
+    for sc in manifest["processes"]:
+        path = gen_dir / sc["file"]
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                for e in sc["shards"]:
+                    data = np.asarray(z[e["key"]])
+                    if list(data.shape) != list(e["shape"]):
+                        raise CheckpointCorruptError(
+                            f"{path.name}[{e['key']}] shape {data.shape} "
+                            f"!= manifest {e['shape']}")
+                    crc = _crc32(data)
+                    if crc != e["crc32"]:
+                        raise CheckpointCorruptError(
+                            f"{path.name}[{e['key']}] CRC32 {crc:#010x} != "
+                            f"manifest {e['crc32']:#010x} — shard is "
+                            "corrupt")
+        except CheckpointCorruptError:
+            raise
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f"{gen_dir}: shard file {sc['file']} is missing")
+        except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
+                OSError, EOFError) as exc:
+            raise CheckpointCorruptError(
+                f"{gen_dir}: shard file {sc['file']} unreadable "
+                f"({type(exc).__name__}: {exc})") from exc
+    return manifest
+
+
+def load_sharded(gen_dir: "str | Path",
+                 *, verify: bool = True) -> Tuple[np.ndarray, dict]:
+    """Reassemble the global array of one committed generation on the
+    host; returns ``(array, meta)``. ``verify=True`` (the default)
+    checks every CRC first — restore NEVER silently accepts a corrupt
+    shard. Host cost is O(global array), same as ``gather_global``."""
+    gen_dir = Path(gen_dir)
+    manifest = verify_sharded(gen_dir) if verify else read_manifest(gen_dir)
+    out = np.zeros(tuple(manifest["global_shape"]),
+                   np.dtype(manifest["dtype"]))
+    for sc in manifest["processes"]:
+        with np.load(gen_dir / sc["file"], allow_pickle=False) as z:
+            for e in sc["shards"]:
+                out[tuple(slice(a, b) for a, b in e["index"])] = z[e["key"]]
+    return out, dict(manifest["meta"])
+
+
+def load_sharded_grid(gen_dir: "str | Path",
+                      *, verify: bool = True) -> Tuple[np.ndarray, dict]:
+    """:func:`load_sharded`, decoded to a dense cell grid per the meta's
+    ``layout`` — the sharded counterpart of :func:`load_grid`."""
+    arr, meta = load_sharded(gen_dir, verify=verify)
+    layout = meta.get("layout")
+    if layout == "packed32":
+        w = meta["shape"][1]
+        return bitpack.unpack_np(arr.astype(np.uint32))[:, :w], meta
+    if layout == "genplanes32":
+        from ..ops.packed_generations import unpack_generations_np
+
+        w = meta["shape"][1]
+        return unpack_generations_np(arr.astype(np.uint32))[:, :w], meta
+    return arr, meta
+
+
+def load_latest_verified(
+    root: "str | Path",
+) -> Tuple[np.ndarray, dict, Path, List[Tuple[Path, str]]]:
+    """Newest generation that verifies clean, falling back generation by
+    generation past torn or corrupt ones. Returns ``(array, meta,
+    gen_dir, skipped)`` where ``skipped`` lists ``(dir, why)`` for every
+    newer generation that was refused — callers surface those as
+    fallback events (registry counters + flight notes). Raises
+    :class:`CheckpointCorruptError` when no generation verifies."""
+    gens = list_generations(root)
+    skipped: List[Tuple[Path, str]] = []
+    for _gen, gen_dir in reversed(gens):
+        try:
+            arr, meta = load_sharded(gen_dir, verify=True)
+        except CheckpointCorruptError as exc:
+            skipped.append((gen_dir, str(exc)))
+            continue
+        return arr, meta, gen_dir, skipped
+    raise CheckpointCorruptError(
+        f"no complete sharded checkpoint generation under {root} "
+        f"({len(gens)} candidate dirs, all refused)")
+
+
+def prune_sharded(root: "str | Path", keep: int = 2) -> List[Path]:
+    """Delete all but the newest ``keep`` *committed* generations (and
+    any uncommitted debris older than them). Never touches dirs newer
+    than the newest manifest — those may be mid-write. Returns what was
+    removed. ``keep >= 2`` preserves the corrupt-shard fallback target."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    gens = list_generations(root)
+    committed = [(g, d) for g, d in gens if (d / MANIFEST_NAME).exists()]
+    if len(committed) <= keep:
+        return []
+    cutoff = committed[-keep][0]
+    removed = []
+    for g, d in gens:
+        if g < cutoff:
+            shutil.rmtree(d, ignore_errors=True)
+            removed.append(d)
+    return removed

@@ -123,6 +123,25 @@ def corrupt_shard(engine: Engine, shard_index: int, seed: int = 0) -> None:
                       at_gen=engine.generation)
 
 
+def corrupt_checkpoint_file(path: "str | Path", *, seed: int = 0,
+                            nbytes: int = 64) -> None:
+    """Flip ``nbytes`` bytes of an on-disk checkpoint file in place — the
+    torn-write/bitrot model for the durability layer (deliberately NOT
+    temp+replace: damaged-in-place is the fault). A sharded-v2 restore
+    must refuse the file (CRC mismatch / unreadable archive →
+    ``CheckpointCorruptError``) and fall back to the previous complete
+    generation; a single-file load must surface the same clean error."""
+    path = Path(path)
+    data = bytearray(path.read_bytes())
+    if not data:
+        raise ValueError(f"{path} is empty; nothing to corrupt")
+    rng = np.random.default_rng(seed)
+    for i in rng.integers(0, len(data), size=min(int(nbytes), len(data))):
+        data[i] ^= 0xFF
+    path.write_bytes(bytes(data))
+    _record_injection("checkpoint_corrupt", path=str(path))
+
+
 # -- validators --------------------------------------------------------------
 
 def population_bounds_validator(min_pop: int = 0, max_pop: Optional[int] = None) -> Validator:

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Multi-host chaos drill: SIGKILL, preemption, and checkpoint rot on a
+live localhost fleet — recovery must be bit-exact.
+
+    python scripts/chaos_multihost.py --seed 0
+
+Launches an :class:`~gameoflifewithactors_tpu.resilience.distributed.
+ElasticFleet` of N real OS processes (multi-controller JAX over
+localhost, torus-sharded grid, sharded v2 checkpoints) and executes a
+seeded :class:`FaultPlan` of the *driver-level* fault kinds:
+
+- ``process_kill`` — SIGKILL a worker mid-run; every survivor must
+  self-detect the dead peer (stale heartbeat / barrier deadline) and
+  exit within the detection bound instead of wedging in a collective;
+- ``process_preempt`` — SIGTERM a worker; it must finish its chunk,
+  checkpoint, and exit with the distinct "preempted" status, and the
+  fleet must re-form *smaller* (the mesh reshapes over n-1 processes);
+- ``checkpoint_corrupt`` — flip bytes in a shard of the newest
+  committed checkpoint generation (then kill its owner); the rebuilt
+  fleet's restore must refuse the corrupt generation by CRC and fall
+  back to the previous complete one.
+
+After the fleet converges, the script replays the same spec on a
+single device (``ops/packed.multi_step_packed`` — no fleet, no faults)
+and asserts the fleet's final grid is **bit-identical** to the
+oracle's: elastic recovery is exact replay, not approximation. It also
+asserts the paper trail: detection latency under the bound, a
+"preempted" status + shrunk roster, a refused generation in some
+worker's restore record, survivor flight dumps on disk, and the driver
+registry's recovery-latency histogram populated.
+
+Writes ``<out>/chaos_report.json`` (fleet report + oracle verdict +
+per-check results). Exit 0 = all green. Same ``--seed`` replays the
+identical fault schedule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import axon_guard  # noqa: E402
+
+from gameoflifewithactors_tpu.resilience.faultplan import (  # noqa: E402
+    FaultEvent, FaultPlan)
+
+
+def build_events(seed: int, workers: int, horizon: int) -> List[FaultEvent]:
+    """The drill's schedule: one event of each driver kind, at seeded
+    generations, targets clamped to workers 0/1 so every event stays
+    addressable after the preemption shrinks the roster."""
+    plan = FaultPlan.generate(
+        seed, workers=2, horizon=horizon, faults_per_worker=0,
+        kinds=("process_kill", "process_preempt", "checkpoint_corrupt"),
+        ensure_kinds=("process_kill", "process_preempt",
+                      "checkpoint_corrupt"))
+    assert workers >= 3, "drill needs >= 3 processes to survive a shrink"
+    return list(plan.events)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="chaos drill for the elastic multi-host runtime")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--processes", type=int, default=4)
+    parser.add_argument("--generations", type=int, default=120)
+    parser.add_argument("--chunk", type=int, default=20)
+    parser.add_argument("--chunk-sleep", type=float, default=0.3,
+                        help="pacing so faults land mid-run")
+    parser.add_argument("--heartbeat-deadline", type=float, default=3.0)
+    parser.add_argument("--barrier-deadline", type=float, default=15.0)
+    parser.add_argument("--out", default="chaos_out")
+    args = parser.parse_args(argv)
+
+    from gameoflifewithactors_tpu.resilience.distributed import (
+        EXIT_PREEMPTED, ElasticFleet, ElasticSpec, initial_grid)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    spec = ElasticSpec(
+        shape=(96, 64), target_gens=args.generations, chunk=args.chunk,
+        rng_seed=args.seed,
+        chunk_sleep_seconds=args.chunk_sleep,
+        heartbeat_deadline_seconds=args.heartbeat_deadline,
+        barrier_deadline_seconds=args.barrier_deadline)
+    events = build_events(args.seed, args.processes, args.generations)
+    print(f"chaos plan (seed {args.seed}): "
+          + ", ".join(f"{e.kind}@gen{e.at_gen}->w{e.worker}"
+                      for e in events), flush=True)
+
+    env = {**os.environ}
+    env["PYTHONPATH"] = axon_guard.strip_pythonpath()
+    env["GOLTPU_SANITIZE"] = env.get("GOLTPU_SANITIZE", "1")
+    fleet = ElasticFleet(out, spec, num_processes=args.processes, env=env)
+    report = fleet.run(events)
+
+    # -- the oracle: same spec, one device, zero faults -----------------------
+    jax = axon_guard.force_cpu(1)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.generations import parse_any
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+
+    packed0 = jnp.asarray(bitpack.pack_np(initial_grid(spec)))
+    oracle = bitpack.unpack_np(np.asarray(multi_step_packed(
+        packed0, spec.target_gens, rule=parse_any(spec.rule),
+        topology=Topology(spec.topology))))[:, :spec.shape[1]]
+
+    checks: List[tuple] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append((name, bool(ok), detail))
+        print(f"  {'PASS' if ok else 'FAIL'}  {name}"
+              + (f"  ({detail})" if detail else ""), flush=True)
+
+    print("chaos drill verdicts:", flush=True)
+    check("fleet converged", report["ok"],
+          f"{len(report['epochs'])} epochs")
+    fired = {f["kind"] for f in report["faults_fired"]}
+    check("all fault kinds fired",
+          fired == {"process_kill", "process_preempt", "checkpoint_corrupt"},
+          f"fired: {sorted(fired)}")
+
+    # detection: after every fault, all survivors exited in bounded time
+    bound = (spec.heartbeat_deadline_seconds
+             + spec.barrier_deadline_seconds + 20.0)
+    detections = [(e["epoch"], e["detection_seconds"])
+                  for e in report["epochs"] if "detection_seconds" in e]
+    check("peer loss detected within deadline",
+          len(detections) == len(report["faults_fired"])
+          and all(d <= bound for _, d in detections),
+          f"{detections} (bound {bound:.0f}s)")
+
+    # preemption: distinct exit status, then a smaller fleet
+    pre_epochs = [e for e in report["epochs"]
+                  if EXIT_PREEMPTED in (e.get("exit_codes") or [])]
+    shrank = any(
+        later["num_processes"] < e["num_processes"]
+        for e in pre_epochs
+        for later in report["epochs"][e["epoch"] + 1:])
+    check("preempted worker exited 17 and fleet re-formed smaller",
+          bool(pre_epochs) and shrank,
+          f"rosters: {[e['num_processes'] for e in report['epochs']]}")
+    statuses = [s for e in report["epochs"]
+                for s in (e.get("statuses") or []) if s]
+    check("preempted status published",
+          any(s["status"] == "preempted" for s in statuses))
+
+    # checkpoint rot: some epoch's restore refused a generation by CRC
+    refused = []
+    for rec in sorted((out / "restore").glob("e*-p*.json")):
+        for d, why in json.loads(rec.read_text()).get("skipped", []):
+            refused.append((rec.name, d, why))
+    check("corrupt generation refused at restore, older one used",
+          any("CRC32" in why or "unreadable" in why
+              for _rec, _d, why in refused),
+          f"{len(refused)} refusals")
+
+    # paper trail: survivors dumped flight tapes; recovery latency landed
+    dumps = list((out / "flight").glob("*.jsonl"))
+    check("survivor flight dumps on disk", len(dumps) > 0,
+          f"{len(dumps)} dumps")
+    recov = report["registry"].get("elastic_recovery_seconds", {})
+    n_recov = sum(s["n"] for s in recov.get("series", []))
+    check("recovery latency histogram populated",
+          n_recov >= len(report["faults_fired"]),
+          f"{n_recov} observations")
+
+    # the one that matters: bit-identical to the unfaulted oracle
+    final_path = report.get("final_grid")
+    if final_path:
+        final = np.load(final_path)
+        identical = final.shape == oracle.shape and (final == oracle).all()
+        check("final grid bit-identical to single-device oracle", identical,
+              f"popcount fleet={int(final.sum())} oracle={int(oracle.sum())}")
+    else:
+        check("final grid bit-identical to single-device oracle", False,
+              "no final grid written")
+
+    ok = all(c[1] for c in checks)
+    report["oracle"] = {"checks": [
+        {"name": n, "ok": o, "detail": d} for n, o, d in checks]}
+    report["ok_with_oracle"] = ok
+    tmp = out / f"chaos_report.json.tmp{os.getpid()}"
+    tmp.write_text(json.dumps(report, indent=2))
+    os.replace(tmp, out / "chaos_report.json")
+    print(("CHAOS-MULTIHOST-OK" if ok else "CHAOS-MULTIHOST-FAILED")
+          + f" report={out / 'chaos_report.json'}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 from gameoflifewithactors_tpu.resilience.faultplan import (  # noqa: E402
-    STATE_KINDS, FaultPlan)
+    DRIVER_KINDS, STATE_KINDS, FaultPlan)
 
 FLAVOR_ORDER = ("packed", "dense", "sparse", "ltl", "ensemble")
 SHAPES = {"packed": (128, 128), "dense": (128, 128), "sparse": (128, 128),
@@ -72,8 +72,8 @@ def build_specs(args, out: Path, plan: FaultPlan) -> List[dict]:
             "watchdog_deadline": args.watchdog_deadline,
             "chunk_sleep_seconds": args.chunk_sleep,
             "workdir": str(out / f"w{w}"),
-            "events": [e.to_dict()
-                       for e in plan.for_worker(w) if e.kind != "kill"],
+            "events": [e.to_dict() for e in plan.for_worker(w)
+                       if e.kind not in DRIVER_KINDS],
         })
     return specs
 

@@ -424,3 +424,99 @@ def test_worker_subprocess_recovers_and_reports(tmp_path):
         {"fault:corrupt_region": 1}
     assert member["supervisor"]["retraces_attributed"] == 1
     assert (workdir / "final.npy").exists()
+
+
+# -- checkpoint rot as a routine event (ISSUE 14 satellites) -------------------
+
+def test_load_grid_corrupt_npz_raises_clean_error(tmp_path):
+    """Truncated or byte-flipped NPZ surfaces as CheckpointCorruptError
+    (a ValueError), never a raw zipfile/zlib traceback; a missing file
+    stays FileNotFoundError — absence is not damage."""
+    c = _coordinator(shape=(32, 32))
+    path = tmp_path / "ck.npz"
+    ckpt_lib.save(c.engine, path)
+
+    whole = path.read_bytes()
+    (tmp_path / "truncated.npz").write_bytes(whole[: len(whole) // 2])
+    with pytest.raises(ckpt_lib.CheckpointCorruptError):
+        ckpt_lib.load_grid(tmp_path / "truncated.npz")
+
+    (tmp_path / "junk.npz").write_bytes(b"this was never a checkpoint")
+    with pytest.raises(ckpt_lib.CheckpointCorruptError):
+        ckpt_lib.load_grid(tmp_path / "junk.npz")
+
+    flipped = tmp_path / "flipped.npz"
+    flipped.write_bytes(whole)
+    fault_lib.corrupt_checkpoint_file(flipped, seed=0)
+    with pytest.raises(ValueError):  # the subclass contract: old call
+        ckpt_lib.load_grid(flipped)  # sites catching ValueError still work
+
+    with pytest.raises(FileNotFoundError):
+        ckpt_lib.load_grid(tmp_path / "never-existed.npz")
+
+
+def test_supervisor_falls_back_to_previous_checkpoint(tmp_path):
+    """A rotted current checkpoint is a routine fall-back-to-.prev at
+    restart, not a crash — and the replay from the older restore point
+    still converges bit-exactly."""
+    path = tmp_path / "ck.npz"
+    sup = Supervisor(_coordinator(), checkpoint_path=str(path),
+                     checkpoint_every=10, sleep_fn=lambda s: None)
+    corrupted = []
+
+    def before_chunk(gen):
+        if gen == 30 and not corrupted:
+            # rot the live checkpoint, then trip a detected fault so the
+            # supervisor must restore right through the rot
+            fault_lib.corrupt_checkpoint_file(path, seed=4)
+            corrupted.append(gen)
+            sup.inject("corrupt_region",
+                       lambda e: fault_lib.corrupt_region(e, 0, 0, 8, 8,
+                                                          seed=9))
+
+    sup.before_chunk = before_chunk
+    stats = sup.run(50)
+    assert stats["checkpoint_fallbacks"] == 1
+    assert stats["restarts"] >= 1
+    assert (tmp_path / "ck.npz.prev").exists()
+    np.testing.assert_array_equal(sup.coordinator.snapshot(),
+                                  _oracle_grid(50))
+
+
+def test_faultplan_driver_kinds_schedule_and_refuse_in_process():
+    """The distributed kinds ride the same seeded/JSON plan machinery,
+    are never drawn for in-process workers, and in-process application
+    refuses them by construction."""
+    from gameoflifewithactors_tpu.resilience import DRIVER_KINDS
+
+    plan = FaultPlan.generate(
+        7, workers=2, horizon=120, faults_per_worker=0,
+        kinds=DRIVER_KINDS,
+        ensure_kinds=("process_kill", "process_preempt",
+                      "checkpoint_corrupt"))
+    kinds = plan.kinds()
+    assert kinds == ["checkpoint_corrupt", "process_kill",
+                     "process_preempt"]
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    assert plan == FaultPlan.generate(
+        7, workers=2, horizon=120, faults_per_worker=0,
+        kinds=DRIVER_KINDS,
+        ensure_kinds=("process_kill", "process_preempt",
+                      "checkpoint_corrupt"))
+    by_kind = {e.kind: e for e in plan.events}
+    assert by_kind["process_preempt"].params["grace_seconds"] > 0
+    assert "seed" in by_kind["checkpoint_corrupt"].params
+
+    # random draws must never produce a driver kind
+    spray = FaultPlan.generate(11, workers=3, horizon=120,
+                               faults_per_worker=5)
+    assert not {e.kind for e in spray.events} & set(DRIVER_KINDS)
+    # asking for random draws from a driver-only pool is a planning bug
+    with pytest.raises(ValueError, match="in-process"):
+        FaultPlan.generate(0, workers=1, horizon=100,
+                           faults_per_worker=1, kinds=("process_kill",))
+
+    sup = Supervisor(_coordinator(), checkpoint_path=str("unused.npz"),
+                     checkpoint_every=10, sleep_fn=lambda s: None)
+    with pytest.raises(ValueError, match="fleet driver"):
+        apply_fault(sup, by_kind["process_kill"])
