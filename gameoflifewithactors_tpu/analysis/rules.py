@@ -1,4 +1,4 @@
-"""The built-in goltpu-lint rules (GOL001…GOL006).
+"""The built-in goltpu-lint rules (GOL001…GOL007).
 
 Each rule encodes one invariant this codebase actually depends on — the
 failure classes the telemetry layer (obs/) can only report after the
@@ -22,6 +22,9 @@ is worse than a narrow one.
 |        | phases use obs.spans; wall-clock stamps carry a pragma       |
 | GOL006 | no bare ``jax.jit`` outside the ops/_jit.py choke point —    |
 |        | untracked jits silently escape compile-event accounting      |
+| GOL007 | obs/ classes that own a ``_lock`` READ their ``self._cache`` |
+|        | scrape-cache state only under it (GOL004 covers writes; a    |
+|        | torn read of a (stamp, payload) tuple is just as racy)       |
 """
 
 from __future__ import annotations
@@ -445,6 +448,71 @@ def _lock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
                         f"{cls.name}.{fn.name} — obs/ recorders are "
                         "read from monitor/exporter threads; hold the "
                         "lock or pragma why this access is safe"))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, in_lock)
+
+            for child in fn.body:
+                walk(child, False)
+
+        for fn in cls.body:
+            if isinstance(fn, ast.FunctionDef) and fn.name != "__init__":
+                check_fn(fn)
+    return out
+
+
+# -- GOL007: obs/ scrape-cache read discipline --------------------------------
+
+
+@register("GOL007", "cache-read-discipline",
+          "obs/ scrape caches are read only under the owning class's lock")
+def _cache_read_discipline(ctx: ModuleContext) -> Iterable[Finding]:
+    """GOL004's mirror for *reads*: a TTL scrape cache like
+    ``FleetAggregator._cache`` holds a (stamp, payload) tuple replaced
+    wholesale under the lock — reading it lock-free can observe the
+    swap mid-publication on a free-threaded build, and the pattern
+    invites "just peek at it" drift. Narrow on purpose: only ``self``
+    attributes whose name contains ``cache``, only in obs/ classes that
+    own a lock, and never inside ``__init__`` (publication happens
+    before the object escapes)."""
+    if not ctx.in_obs:
+        return []
+    out: List[Finding] = []
+    for cls in [n for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)]:
+        locks = _lock_attr_names(cls)
+        if not locks:
+            continue
+
+        def check_fn(fn: ast.FunctionDef) -> None:
+            def walk(node: ast.AST, in_lock: bool) -> None:
+                if isinstance(node, ast.With):
+                    holds = any(
+                        isinstance(item.context_expr, ast.Attribute)
+                        and isinstance(item.context_expr.value, ast.Name)
+                        and item.context_expr.value.id == "self"
+                        and item.context_expr.attr in locks
+                        for item in node.items)
+                    for child in node.body:
+                        walk(child, in_lock or holds)
+                    return
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)) \
+                        and node is not fn:
+                    return
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr.startswith("_") \
+                        and "cache" in node.attr \
+                        and node.attr not in locks and not in_lock:
+                    out.append(ctx.finding(
+                        "GOL007", node,
+                        f"`self.{node.attr}` read outside "
+                        f"`with self.{sorted(locks)[0]}:` in "
+                        f"{cls.name}.{fn.name} — the scrape cache is "
+                        "republished wholesale under the lock; snapshot "
+                        "it under the lock and work on the local"))
                 for child in ast.iter_child_nodes(node):
                     walk(child, in_lock)
 

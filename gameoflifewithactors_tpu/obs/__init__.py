@@ -48,7 +48,20 @@ plumbing must stay loadable and usable while a TPU tunnel is wedged —
 that is precisely when their output matters most.
 """
 
-from .spans import Span, SpanTracer, TRACER, span  # noqa: F401
+from .spans import (  # noqa: F401
+    Span,
+    SpanTracer,
+    TRACER,
+    TRACE_ENV_VAR,
+    TraceContext,
+    bind_trace,
+    current_trace,
+    new_span_id,
+    new_trace_id,
+    parse_trace_header,
+    set_process_context,
+    span,
+)
 from .registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -71,6 +84,9 @@ from .diff import diff_records, format_rows, gate  # noqa: F401
 
 __all__ = [
     "Span", "SpanTracer", "TRACER", "span",
+    "TRACE_ENV_VAR", "TraceContext", "bind_trace", "current_trace",
+    "new_span_id", "new_trace_id", "parse_trace_header",
+    "set_process_context",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "CompileEvent", "CompileEventLog", "COMPILE_LOG", "tracked_call",
     "StallEvent", "StallWatchdog", "active_watchdog", "arm", "disarm",

@@ -171,12 +171,18 @@ class FlightRecorder:
             notes = list(self._notes)
             stalls = list(self._stalls)
         last = self._tracer.last_completed()
+        ctx = spans_lib.current_trace()
         header = {
             "type": "flight",
             "schema_version": SCHEMA_VERSION,
             "reason": reason,
             "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "pid": os.getpid(),
+            # this process's wall-clock <-> perf_counter anchor (written at
+            # tracer startup): obs/aggregate.py aligns per-process tapes
+            # onto one timeline by adding it to every t/t0 in the dump
+            "epoch_anchor": self._tracer.epoch_anchor,
+            "trace_id": ctx.trace_id if ctx is not None else None,
             "last_completed_span": last.name if last else None,
             "open_spans": self._tracer.current_stack(),
             "counts": {"step_metrics": len(steps), "spans": len(spans),

@@ -11,6 +11,18 @@ next to a ``jax.profiler`` perfetto dump for a combined timeline.
 The default :data:`TRACER` is always on: recording a span is two
 ``perf_counter`` calls and a deque append (~1 µs), noise against a
 device dispatch, and the ring buffer bounds memory on long runs.
+
+**Distributed trace context.** A :class:`TraceContext` (128-bit trace id
+plus the parent span's 64-bit id) can be bound to the current thread
+with :func:`bind_trace`, or to the whole process via the
+``GOLTPU_TRACE`` env var (how a fleet driver makes worker spans nest
+under its own span — see ``resilience/`` and ``scripts/soak.py``).
+While a context is in effect, every recorded span carries ``trace_id``,
+its own ``span_id``, and ``parent_id`` (the enclosing open span, or the
+bound context's span id for roots), so per-process tapes merge into one
+end-to-end trace in ``obs/aggregate.py``. With no context bound, the
+fields stay ``None`` and the record path costs exactly what it did
+before — the telemetry CLI's perf budget is unchanged.
 """
 
 from __future__ import annotations
@@ -26,6 +38,110 @@ from typing import Deque, Iterator, List, Optional, TextIO
 
 DEFAULT_MAX_SPANS = 65536
 
+#: Env var carrying a parent trace context into child processes
+#: (``"<32-hex trace id>"`` or ``"<32-hex trace id>:<16-hex span id>"``).
+TRACE_ENV_VAR = "GOLTPU_TRACE"
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id as 32 lowercase hex chars."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 64-bit span id as 16 lowercase hex chars."""
+    return os.urandom(8).hex()
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """The ambient trace a thread/process records spans under.
+
+    ``span_id`` is the *parent* for root spans opened while this context
+    is bound — the fleet driver's span id when inherited via env, the
+    caller's span id when it arrived on an ``X-Goltpu-Trace`` header, or
+    ``None`` when the caller supplied only a trace id."""
+
+    trace_id: str
+    span_id: Optional[str] = None
+
+    def header(self) -> str:
+        """The wire form (HTTP header / env var value)."""
+        return (f"{self.trace_id}:{self.span_id}" if self.span_id
+                else self.trace_id)
+
+    def child_env(self) -> dict:
+        """Env entries that make a subprocess inherit this context."""
+        return {TRACE_ENV_VAR: self.header()}
+
+
+def parse_trace_header(value: str) -> TraceContext:
+    """Parse ``"<trace id>[:<span id>]"``; raises ``ValueError`` on
+    anything that is not 32 (+ optional 16) hex chars."""
+    hexdigits = set("0123456789abcdef")
+    parts = value.strip().split(":")
+    if len(parts) not in (1, 2):
+        raise ValueError(f"malformed trace header: {value!r}")
+    trace_id, span_id = parts[0], (parts[1] if len(parts) == 2 else None)
+    if len(trace_id) != 32 or not set(trace_id) <= hexdigits:
+        raise ValueError(f"malformed trace header: {value!r}")
+    if span_id is not None and (len(span_id) != 16
+                                or not set(span_id) <= hexdigits):
+        raise ValueError(f"malformed trace header: {value!r}")
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+_TRACE_LOCAL = threading.local()
+
+
+def _context_from_env() -> Optional[TraceContext]:
+    raw = os.environ.get(TRACE_ENV_VAR, "").strip()
+    if not raw:
+        return None
+    try:
+        return parse_trace_header(raw)
+    except ValueError:
+        return None  # a garbled env var must not break the child
+
+
+#: Process-wide ambient context (inherited from ``GOLTPU_TRACE`` at
+#: import — how worker spans nest under the fleet driver's span).
+_PROCESS_CONTEXT: Optional[TraceContext] = _context_from_env()
+
+
+def set_process_context(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install (or clear, with ``None``) the process-ambient context;
+    returns the previous one so callers can restore it."""
+    global _PROCESS_CONTEXT
+    prev = _PROCESS_CONTEXT
+    _PROCESS_CONTEXT = ctx
+    return prev
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The context in effect on this thread: a :func:`bind_trace` binding
+    wins; otherwise the process-ambient (env-inherited) context."""
+    ctx = getattr(_TRACE_LOCAL, "ctx", None)
+    return ctx if ctx is not None else _PROCESS_CONTEXT
+
+
+@contextlib.contextmanager
+def bind_trace(trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None) -> Iterator[TraceContext]:
+    """Bind a trace context to the current thread for the block.
+
+    ``trace_id=None`` mints a fresh one (the frontend's "no caller
+    header" path). Bindings nest; the previous binding is restored on
+    exit, so concurrent request threads can never cross-contaminate."""
+    ctx = TraceContext(trace_id=trace_id or new_trace_id(),
+                       span_id=parent_id)
+    prev = getattr(_TRACE_LOCAL, "ctx", None)
+    _TRACE_LOCAL.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TRACE_LOCAL.ctx = prev
+
 
 @dataclasses.dataclass(frozen=True)
 class Span:
@@ -39,6 +155,10 @@ class Span:
     thread_name: str
     depth: int                      # nesting level at record time (0 = root)
     attrs: Optional[dict] = None
+    # distributed trace identity — None unless a TraceContext was bound
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
 
     @property
     def seconds(self) -> float:
@@ -50,6 +170,12 @@ class Span:
              "depth": self.depth}
         if self.attrs:
             d["attrs"] = self.attrs
+        # additive: untraced spans serialize exactly as before
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+            if self.parent_id is not None:
+                d["parent_id"] = self.parent_id
         return d
 
 
@@ -90,15 +216,29 @@ class SpanTracer:
         stack = self._live_stack()
         depth = len(stack)
         stack.append(name)
+        # trace identity only when a context is bound: the untraced fast
+        # path stays two perf_counter calls + an append (the perf budget)
+        ctx = current_trace()
+        if ctx is not None:
+            ids = self._live_ids()
+            span_id = new_span_id()
+            parent_id = ids[-1] if ids else ctx.span_id
+            ids.append(span_id)
+        else:
+            ids = span_id = parent_id = None
         t0 = time.perf_counter()
         try:
             yield
         finally:
             t1 = time.perf_counter()
             stack.pop()
+            if ids is not None:
+                ids.pop()
             th = threading.current_thread()
             s = Span(name=name, t0=t0, t1=t1, thread_id=th.ident or 0,
-                     thread_name=th.name, depth=depth, attrs=attrs or None)
+                     thread_name=th.name, depth=depth, attrs=attrs or None,
+                     trace_id=ctx.trace_id if ctx is not None else None,
+                     span_id=span_id, parent_id=parent_id)
             with self._lock:
                 self._spans.append(s)
                 self._last = s
@@ -136,6 +276,15 @@ class SpanTracer:
         if stack is None:
             stack = self._local.stack = []
         return stack
+
+    def _live_ids(self) -> List[str]:
+        """The calling thread's open-span *id* stack — parallel to
+        ``_live_stack`` but only maintained while a trace context is
+        bound, so the untraced record path never touches it."""
+        ids = getattr(self._local, "ids", None)
+        if ids is None:
+            ids = self._local.ids = []
+        return ids
 
     def clear(self) -> None:
         with self._lock:
@@ -183,8 +332,14 @@ class SpanTracer:
                 "ts": (s.t0 + self.epoch_anchor) * 1e6,
                 "dur": s.seconds * 1e6,
             }
-            if s.attrs:
-                ev["args"] = s.attrs
+            args = dict(s.attrs) if s.attrs else {}
+            if s.trace_id is not None:
+                args["trace_id"] = s.trace_id
+                args["span_id"] = s.span_id
+                if s.parent_id is not None:
+                    args["parent_id"] = s.parent_id
+            if args:
+                ev["args"] = args
             events.append(ev)
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 

@@ -63,6 +63,7 @@ from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..obs import flight as obs_flight
+from ..obs import spans as obs_spans
 from ..obs.registry import REGISTRY
 from ..utils import checkpoint as ckpt_lib
 from ..utils.checkpoint import CheckpointCorruptError
@@ -370,6 +371,12 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
         str(flight_dir / f"e{epoch:03d}-p{process_id:04d}.jsonl"))
     fr.install(signals=False)  # SIGTERM means preempt here, not die
     obs_flight.arm(fr)
+    # the driver passes GOLTPU_TRACE through the env (spans.py reads it
+    # at import), so every span below nests under the fleet driver's
+    # trace; nothing else to do here — but tape a breadcrumb so the
+    # merged timeline shows when this worker joined
+    fr.note("worker_start", {"process_id": process_id, "epoch": epoch,
+                             "num_processes": num_processes})
 
     preempted = threading.Event()
 
@@ -456,8 +463,11 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
         while gen < spec.target_gens:
             _sync(f"c{gen:08d}-pre")
             k = min(spec.chunk, spec.target_gens - gen)
-            state = runner(state, k)
-            jax.block_until_ready(state)
+            with obs_spans.span("elastic.chunk", epoch=epoch,
+                                process_id=process_id,
+                                start_gen=gen, generations=k):
+                state = runner(state, k)
+                jax.block_until_ready(state)
             gen += k
             hb.set_generation(gen)
             # sharded checkpoint: shards → barrier → manifest → barrier
@@ -491,6 +501,7 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
                     {"peers": sorted(requested), "at_gen": gen})
                 write_status(rundir, epoch, process_id, "peer_lost", gen,
                              detail=f"peers preempted: {sorted(requested)}")
+                fr.dump(f"peers preempted: {sorted(requested)}")
                 _die(EXIT_PEER_LOST)
             if spec.chunk_sleep_seconds > 0:
                 time.sleep(spec.chunk_sleep_seconds)
@@ -508,6 +519,9 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
                         {"generation": gen, "epoch": epoch,
                          "num_processes": num_processes})
         write_status(rundir, epoch, process_id, "done", gen)
+        # clean exits leave a tape too: the fleet-wide merged timeline
+        # needs every worker's spans, not just the ones that died
+        fr.dump(f"done at generation {gen}")
         _die(EXIT_DONE)
     except PeerLostError as exc:
         monitor.stop()
@@ -609,6 +623,16 @@ class ElasticFleet:
                  self._env.get("PYTHONPATH", "").split(os.pathsep) if p]
         if repo_root not in parts:
             self._env["PYTHONPATH"] = os.pathsep.join([repo_root] + parts)
+        # fleet-wide trace: the driver mints (or inherits) the trace id
+        # and hands workers its own span id as their parent via the env,
+        # so worker spans nest under the driver on the merged timeline
+        ambient = obs_spans.current_trace()
+        self.trace = obs_spans.TraceContext(
+            trace_id=(ambient.trace_id if ambient is not None
+                      else obs_spans.new_trace_id()),
+            span_id=obs_spans.new_span_id())
+        obs_spans.set_process_context(self.trace)
+        self._env.update(self.trace.child_env())
         self.rundir.mkdir(parents=True, exist_ok=True)
         _write_json(self.rundir / "spec.json", spec.to_dict())
 
@@ -667,6 +691,12 @@ class ElasticFleet:
         REGISTRY.counter("elastic_driver_faults_total",
                          "driver-side faults executed, by kind"
                          ).inc(kind=ev.kind)
+        # instant event on the driver tape: kill/preempt/corrupt must be
+        # visible on the merged fleet timeline, not just in the report
+        obs_flight.note_event(
+            "driver_fault",
+            {"fault": ev.kind, "worker": ev.worker, "epoch": epoch,
+             "fired_at_gen": fired_gen, "detail": rec.detail})
         return rec
 
     def _epoch_deadline(self) -> float:
@@ -687,7 +717,9 @@ class ElasticFleet:
         n = self.num_processes
         ok = False
         for epoch in range(self.max_epochs):
-            info = self._run_epoch(epoch, n, pending, fired)
+            with obs_spans.span("elastic.epoch", epoch=epoch,
+                                num_processes=n):
+                info = self._run_epoch(epoch, n, pending, fired)
             epochs.append(info)
             if info["completed"]:
                 ok = True
@@ -698,6 +730,7 @@ class ElasticFleet:
                 break
         final_meta = _read_json(self.rundir / "final.json") or {}
         report = {
+            "trace_id": self.trace.trace_id,
             "spec": self.spec.to_dict(),
             "num_processes_initial": self.num_processes,
             "epochs": epochs,

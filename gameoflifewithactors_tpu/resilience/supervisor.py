@@ -44,6 +44,7 @@ from typing import Callable, Optional, Sequence
 from ..analysis import sanitizers as _sanitizers
 from ..coordinator import GridCoordinator
 from ..obs import flight as obs_flight
+from ..obs import spans as obs_spans
 from ..obs import watchdog as obs_watchdog
 from ..obs.registry import REGISTRY
 from ..utils import checkpoint as ckpt_lib
@@ -233,7 +234,9 @@ class Supervisor:
         wd_mark = len(wd.events) if wd is not None else 0
         exc: Optional[BaseException] = None
         try:
-            self.coordinator.tick(chunk)
+            with obs_spans.span("supervisor.chunk", generations=chunk,
+                                start_gen=self.coordinator.generation):
+                self.coordinator.tick(chunk)
         except Exception as e:  # noqa: BLE001 — the whole point is retry
             exc = e
         with self._lock:
@@ -326,9 +329,11 @@ class Supervisor:
         delay = self.policy.backoff(consecutive)
         if delay > 0:
             self._sleep(delay)
-        grid, meta = self._load_restore_point()
-        self.coordinator.engine.set_grid(grid,
-                                         generation=meta["generation"])
+        with obs_spans.span("supervisor.restart", cause=cause,
+                            attempt=consecutive):
+            grid, meta = self._load_restore_point()
+            self.coordinator.engine.set_grid(grid,
+                                             generation=meta["generation"])
         self._reset_sentinels()
         REGISTRY.counter("supervisor_restarts_total",
                          "checkpoint-restore restarts, by cause"

@@ -74,6 +74,7 @@ def _build_coordinator(spec: dict, member: int, resume: bool):
 def _run_member(spec: dict, member: int, resume: bool,
                 health: dict, health_lock: threading.Lock) -> dict:
     """One supervised member run; returns its report entry."""
+    from ..obs import spans as obs_spans
     from ..resilience import faultplan as plan_lib
     from ..resilience.supervisor import RestartPolicy, Supervisor
 
@@ -122,7 +123,11 @@ def _run_member(spec: dict, member: int, resume: bool,
     with health_lock:
         health["supervisor"] = supervisor
     target = int(spec["generations"])
-    stats = supervisor.run(max(0, target - coordinator.generation))
+    # ambient trace (GOLTPU_TRACE from the soak driver) makes this span
+    # a child of the driver's on the merged fleet timeline
+    with obs_spans.span("soak.member", member=member,
+                        flavor=spec["flavor"], target_gens=target):
+        stats = supervisor.run(max(0, target - coordinator.generation))
     return {
         "member": member,
         "resumed_generation": resumed_gen,
@@ -208,6 +213,10 @@ def run_spec(spec: dict, *, resume: bool = False,
         tmp = workdir / f"report.json.tmp{os.getpid()}"
         tmp.write_text(json.dumps(report, indent=2))
         os.replace(tmp, workdir / "report.json")
+        # always leave a tape (after the report so report["flight_dumps"]
+        # still counts only in-run dumps): the driver's merged timeline
+        # needs spans from clean workers too
+        fr.dump(f"end of run (exit code {code})")
         server.stop()
         unchain()
         obs_flight.disarm()

@@ -43,11 +43,17 @@ from typing import List, Optional
 
 from ..obs import exporter as obs_exporter
 from ..obs import flight as obs_flight
+from ..obs import spans as obs_spans
 from ..obs.registry import REGISTRY
 from .admission import AdmissionController, AdmissionRejected
 from .service import SessionService
 
 _SID = re.compile(r"^/sessions/([^/]+)(/grid|/step)?$")
+
+#: Request header carrying a caller's trace context
+#: (``<32-hex trace id>[:<16-hex parent span id>]``); absent, the
+#: frontend mints a fresh trace id. Echoed on every response.
+TRACE_HEADER = "X-Goltpu-Trace"
 
 
 class SessionFrontend:
@@ -71,12 +77,18 @@ class SessionFrontend:
         service = self.service
 
         class Handler(BaseHTTPRequestHandler):
+            trace_id: Optional[str] = None  # this request's trace
+
             def _send(self, code: int, payload: dict,
                       ctype: str = "application/json") -> None:
+                if self.trace_id is not None and "trace_id" not in payload:
+                    payload = {**payload, "trace_id": self.trace_id}
                 body = (json.dumps(payload) + "\n").encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if self.trace_id is not None:
+                    self.send_header(TRACE_HEADER, self.trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -85,6 +97,8 @@ class SessionFrontend:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if self.trace_id is not None:
+                    self.send_header(TRACE_HEADER, self.trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -96,17 +110,35 @@ class SessionFrontend:
 
             def _dispatch(self, method: str) -> None:
                 path = self.path.split("?")[0]
+                # one request = one trace: accept the caller's context
+                # (continuing their trace under their parent span) or
+                # mint a fresh 128-bit id. Binding is thread-local and
+                # request threads are per-connection, so two concurrent
+                # requests cannot cross-contaminate.
+                header = self.headers.get(TRACE_HEADER)
                 try:
-                    self._route(method, path)
-                except (KeyError, FileNotFoundError) as exc:
-                    self._send(404, {"error": str(exc)})
-                except AdmissionRejected as exc:
-                    self._send(429, {"error": str(exc)})
-                except (ValueError, json.JSONDecodeError) as exc:
+                    caller = (obs_spans.parse_trace_header(header)
+                              if header else None)
+                except ValueError as exc:
                     self._send(400, {"error": str(exc)})
-                except Exception as exc:  # noqa: BLE001 — HTTP boundary
-                    self._send(500, {"error":
-                                     f"{type(exc).__name__}: {exc}"})
+                    return
+                with obs_spans.bind_trace(
+                        caller.trace_id if caller else None,
+                        caller.span_id if caller else None) as ctx:
+                    self.trace_id = ctx.trace_id
+                    with obs_spans.span("serve.request", method=method,
+                                        path=path):
+                        try:
+                            self._route(method, path)
+                        except (KeyError, FileNotFoundError) as exc:
+                            self._send(404, {"error": str(exc)})
+                        except AdmissionRejected as exc:
+                            self._send(429, {"error": str(exc)})
+                        except (ValueError, json.JSONDecodeError) as exc:
+                            self._send(400, {"error": str(exc)})
+                        except Exception as exc:  # noqa: BLE001 — HTTP boundary
+                            self._send(500, {"error":
+                                             f"{type(exc).__name__}: {exc}"})
 
             def _route(self, method: str, path: str) -> None:
                 if method == "GET" and path in ("/metrics", "/"):

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..models.generations import parse_any
 from ..models.rules import Rule
+from ..obs import spans as obs_spans
 from ..ops import bitpack
 from ..ops.stencil import Topology
 from ..parallel import batched
@@ -234,12 +235,17 @@ class Lane:
             self.fail_next = False
             raise RuntimeError(
                 f"injected lane fault ({self.lane_id})")
-        out = self._runner(self.state, int(n),
-                           np.ascontiguousarray(mask, dtype=np.uint32))
-        # copy=True: np.asarray of a CPU jax.Array is a read-only
-        # zero-copy view that dangles once the device buffer is freed —
-        # slot surgery needs an owned, writable buffer
-        self.state = np.array(out, dtype=np.uint32, copy=True)
+        # same span name as Engine.step: a lane batch IS the engine step
+        # of its slots, and the end-to-end request trace must bottom out
+        # at the same leaf either way
+        with obs_spans.span("engine.step", generations=int(n),
+                            lane=self.lane_id, capacity=self.capacity):
+            out = self._runner(self.state, int(n),
+                               np.ascontiguousarray(mask, dtype=np.uint32))
+            # copy=True: np.asarray of a CPU jax.Array is a read-only
+            # zero-copy view that dangles once the device buffer is freed —
+            # slot surgery needs an owned, writable buffer
+            self.state = np.array(out, dtype=np.uint32, copy=True)
         self.steps_dispatched += int(n)
 
     def stats(self) -> dict:

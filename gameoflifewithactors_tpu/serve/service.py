@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs import flight as obs_flight
+from ..obs import spans as obs_spans
 from ..obs.registry import REGISTRY, MetricsRegistry
 from ..ops import bitpack
 from ..resilience.supervisor import RestartPolicy
@@ -119,6 +120,22 @@ class SessionService:
         self._m_evictions = reg.counter(
             "session_evictions_total",
             "sessions evicted (lane circuit open), per family")
+        # per-tenant SLO surface: request-phase latencies (queue wait has
+        # its own histogram in admission.py) and a recent-throughput gauge
+        self._m_phase = reg.histogram(
+            "session_phase_seconds",
+            "request-phase latency by phase "
+            "(admission / dispatch / first_step), per tenant")
+        self._m_tenant_rate = reg.gauge(
+            "tenant_steps_per_sec",
+            "recent per-tenant step throughput on THIS process's chips "
+            "(per-chip: list across procs, never sum)")
+        # sid -> perf_counter at create, pending its first stepped
+        # generation (the time-to-first-step phase)
+        self._first_step_t0: Dict[str, float] = {}
+        # tenant -> (perf_counter, cumulative steps) anchoring the rate
+        self._rate_anchor: Dict[str, Tuple[float, float]] = {}
+        self._tenant_steps: Dict[str, float] = {}
 
     # -- warm start ----------------------------------------------------------
 
@@ -154,8 +171,13 @@ class SessionService:
         words = self._seed_words(family, fill, rng_seed, cells_hex)
         with self._lock:
             pool = self._pool(family)
-            verdict = self.admission.decide(family.slot_bytes(),
-                                            tenant=tenant)
+            t_adm = time.perf_counter()
+            with obs_spans.span("serve.admission", tenant=tenant,
+                                family=family.key):
+                verdict = self.admission.decide(family.slot_bytes(),
+                                                tenant=tenant)
+            self._m_phase.observe(time.perf_counter() - t_adm,
+                                  phase="admission", tenant=tenant)
             if verdict == REJECT:
                 raise AdmissionRejected(
                     f"over HBM budget and the admission queue is full "
@@ -165,6 +187,7 @@ class SessionService:
                         spec=family.canonical_spec())
             self.store.add(s)
             self._known_tenants.add(tenant)
+            self._first_step_t0[sid] = time.perf_counter()
             if verdict == QUEUE:
                 s.parked = words
                 self.admission.enqueue(sid, time.perf_counter())
@@ -185,7 +208,12 @@ class SessionService:
                 raise ValueError(f"session {sid} is {s.state}")
             s.pending_steps += int(n)
             if pump:
+                t0 = time.perf_counter()
                 self.pump()
+                # dispatch latency attributed to the requesting tenant:
+                # how long this step call waited for its lane dispatches
+                self._m_phase.observe(time.perf_counter() - t0,
+                                      phase="dispatch", tenant=s.tenant)
             return self._info(s)
 
     def close(self, sid: str) -> dict:
@@ -202,6 +230,7 @@ class SessionService:
             s.pending_steps = 0
             s.transition(CLOSED)
             self._recovery.pop(sid, None)
+            self._first_step_t0.pop(sid, None)
             self._drain_queue()
             self._refresh_gauges()
             return self._info(s)
@@ -254,12 +283,17 @@ class SessionService:
             active = pend > 0
             n = int(pend[active].min())
             try:
-                lane.step(n, active.astype(np.uint32))
+                with obs_spans.span("lane.dispatch", lane=lane.lane_id,
+                                    family=pool.family.key,
+                                    generations=n,
+                                    slots=int(active.sum())):
+                    lane.step(n, active.astype(np.uint32))
             except Exception as exc:  # noqa: BLE001 — restart is the point
                 if not self._recover_lane(pool, lane, exc):
                     return dispatches  # circuit opened; lane is gone
                 continue  # debts were re-credited; recompute and retry
             dispatches += 1
+            now = time.perf_counter()
             self._lane_failures.pop(lane.lane_id, None)
             for i, s in enumerate(holders):
                 if s is not None and active[i]:
@@ -268,6 +302,12 @@ class SessionService:
                     if s.state == PACKED:
                         s.transition(RUNNING)
                     self._m_steps.inc(n, tenant=s.tenant)
+                    self._tenant_steps[s.tenant] = \
+                        self._tenant_steps.get(s.tenant, 0.0) + n
+                    t0 = self._first_step_t0.pop(s.sid, None)
+                    if t0 is not None:
+                        self._m_phase.observe(now - t0, phase="first_step",
+                                              tenant=s.tenant)
 
     # -- lane recovery -------------------------------------------------------
 
@@ -317,6 +357,7 @@ class SessionService:
             s.lane_id = s.slot = None
             s.transition(EVICTED)
             self._recovery.pop(sid, None)
+            self._first_step_t0.pop(sid, None)
             self._m_evictions.inc(family=pool.family.key)
         pool.lanes.pop(lane.lane_id, None)
         obs_flight.note_event(
@@ -486,10 +527,26 @@ class SessionService:
                 "family": s.family_key, "spec": dict(s.spec),
                 "lane": s.lane_id, "slot": s.slot}
 
+    # refuse sub-window samples: back-to-back pumps would otherwise
+    # publish rates computed over microsecond baselines (pure noise)
+    RATE_WINDOW_SECONDS = 0.25
+
     def _refresh_gauges(self) -> None:
         tenants = self.store.tenants()
         for tenant in self._known_tenants:
             self._m_live.set(tenants.get(tenant, 0), tenant=tenant)
+        now = time.perf_counter()
+        for tenant, total in self._tenant_steps.items():
+            anchor = self._rate_anchor.get(tenant)
+            if anchor is None:
+                self._rate_anchor[tenant] = (now, total)
+                continue
+            last_t, last_total = anchor
+            dt = now - last_t
+            if dt >= self.RATE_WINDOW_SECONDS:
+                self._m_tenant_rate.set((total - last_total) / dt,
+                                        tenant=tenant)
+                self._rate_anchor[tenant] = (now, total)
         for key, pool in self.pools.items():
             self._m_lanes.set(len(pool.lanes), family=key)
             self._m_slots_live.set(pool.live_count(), family=key)

@@ -95,11 +95,23 @@ def main(argv=None) -> int:
           + ", ".join(f"{e.kind}@gen{e.at_gen}->w{e.worker}"
                       for e in events), flush=True)
 
+    from gameoflifewithactors_tpu.obs import aggregate as obs_aggregate
+    from gameoflifewithactors_tpu.obs import exporter as obs_exporter
+    from gameoflifewithactors_tpu.obs import flight as obs_flight
+    from gameoflifewithactors_tpu.obs.registry import REGISTRY
+
     env = {**os.environ}
     env["PYTHONPATH"] = axon_guard.strip_pythonpath()
     env["GOLTPU_SANITIZE"] = env.get("GOLTPU_SANITIZE", "1")
+    # driver tape: armed before the fleet so _fire's kill/preempt/corrupt
+    # events land on it and show up on the merged fleet timeline
+    fr = obs_flight.FlightRecorder(str(out / "driver-flight.jsonl"))
+    fr.install(signals=False)
+    obs_flight.arm(fr)
     fleet = ElasticFleet(out, spec, num_processes=args.processes, env=env)
     report = fleet.run(events)
+    fr.dump("chaos driver done")
+    obs_flight.disarm()
 
     # -- the oracle: same spec, one device, zero faults -----------------------
     jax = axon_guard.force_cpu(1)
@@ -175,6 +187,42 @@ def main(argv=None) -> int:
     check("recovery latency histogram populated",
           n_recov >= len(report["faults_fired"]),
           f"{n_recov} observations")
+
+    # -- the merged fleet timeline: ONE clock-aligned chrome trace ------------
+    tapes = sorted((out / "flight").glob("*.jsonl"))
+    tapes.append(out / "driver-flight.jsonl")
+    timeline_path = obs_aggregate.write_merged_timeline(
+        str(out / "timeline.json"),
+        flight_dumps=[str(p) for p in tapes if p.exists()])
+    timeline = json.loads(Path(timeline_path).read_text())
+    problems = obs_aggregate.validate_timeline(timeline)
+    check("merged timeline clock-aligned", not problems
+          and not timeline.get("unaligned"),
+          f"{len(problems)} problems, "
+          f"{len(timeline.get('unaligned', []))} unaligned")
+    ranks = {int(lbl.rsplit("-p", 1)[1])
+             for lbl in timeline.get("flight_headers", {}) if "-p" in lbl}
+    check("timeline has tapes from every worker rank plus the driver",
+          ranks == set(range(args.processes))
+          and "driver-flight" in timeline.get("flight_headers", {}),
+          f"ranks {sorted(ranks)}")
+    span_tids = {ev.get("args", {}).get("trace_id")
+                 for ev in timeline["traceEvents"]
+                 if ev.get("ph") == "X"}
+    check("worker and driver spans share the fleet trace id",
+          span_tids == {report["trace_id"]},
+          f"{len(span_tids)} distinct trace ids in spans")
+    fault_kinds = {(ev.get("args") or {}).get("fault")
+                   for ev in timeline["traceEvents"]
+                   if ev.get("name") == "driver_fault"}
+    check("kill/preempt/corrupt events visible on the timeline",
+          {"process_kill", "process_preempt",
+           "checkpoint_corrupt"} <= fault_kinds,
+          f"saw {sorted(k for k in fault_kinds if k)}")
+    # aggregated driver metrics, proc-labeled like a fleet scrape
+    (out / "fleet_metrics.prom").write_text(
+        obs_aggregate.merge_expositions(
+            {"driver": obs_exporter.render_prometheus(REGISTRY.snapshot())}))
 
     # the one that matters: bit-identical to the unfaulted oracle
     final_path = report.get("final_grid")

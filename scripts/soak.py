@@ -49,6 +49,9 @@ from typing import List, Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from gameoflifewithactors_tpu.obs import aggregate as obs_aggregate  # noqa: E402
+from gameoflifewithactors_tpu.obs import flight as obs_flight  # noqa: E402
+from gameoflifewithactors_tpu.obs import spans as obs_spans  # noqa: E402
 from gameoflifewithactors_tpu.resilience.faultplan import (  # noqa: E402
     DRIVER_KINDS, STATE_KINDS, FaultPlan)
 
@@ -174,6 +177,10 @@ def run_fleet(args, out: Path, specs: List[dict], plan: FaultPlan,
                 p.proc.wait()
                 killed[i] = {"worker": i, "scheduled_at_gen": ev.at_gen,
                              "killed_at_gen": p.last_health["generation"]}
+                obs_flight.note_event(
+                    "driver_kill",
+                    {"worker": i,
+                     "at_gen": p.last_health["generation"]})
                 print(f"soak: SIGKILL w{i} at generation "
                       f"{p.last_health['generation']} (scheduled "
                       f">= {ev.at_gen}); resuming", flush=True)
@@ -188,6 +195,11 @@ def run_fleet(args, out: Path, specs: List[dict], plan: FaultPlan,
         time.sleep(args.poll_seconds)
 
     results = {"workers": [], "oracles": [], "killed": list(killed.values())}
+    # last scraped exposition per process, for the fleet-wide merged
+    # metrics artifact (popped out of the report before it is written)
+    results["expositions"] = dict(
+        {f"w{i}": p.last_metrics for i, p in enumerate(workers)},
+        **{f"oracle{i}": p.last_metrics for i, p in enumerate(oracles)})
     for kind, procs in (("workers", workers), ("oracles", oracles)):
         for p in procs:
             rc = p.proc.poll()
@@ -343,13 +355,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.tpu:
         env["JAX_PLATFORMS"] = "cpu"
 
+    # fleet trace: workers inherit the driver's trace id + span id via
+    # GOLTPU_TRACE, so their spans nest under the driver on the merged
+    # timeline; the driver tapes its own kills into driver-flight.jsonl
+    ctx = obs_spans.TraceContext(obs_spans.new_trace_id(),
+                                 obs_spans.new_span_id())
+    obs_spans.set_process_context(ctx)
+    env.update(ctx.child_env())
+    fr = obs_flight.FlightRecorder(str(out / "driver-flight.jsonl"))
+    fr.install(signals=False)
+    obs_flight.arm(fr)
+
     t0 = time.perf_counter()
-    results = run_fleet(args, out, specs, plan, env)
+    with obs_spans.span("soak.fleet", seed=args.seed,
+                        processes=args.processes):
+        results = run_fleet(args, out, specs, plan, env)
     wall = time.perf_counter() - t0
     failures = check_invariants(args, results, specs, plan)
 
+    expositions = results.pop("expositions", {})
+    live = {k: v for k, v in expositions.items() if v}
+    if live:
+        (out / "fleet_metrics.prom").write_text(
+            obs_aggregate.merge_expositions(live))
+    fr.dump(f"soak driver done (failures={len(failures)})")
+    obs_flight.disarm()
+    dumps = sorted(out.glob("*/flight.jsonl"))
+    dumps.append(out / "driver-flight.jsonl")
+    dumps = [p for p in dumps if p.exists()]
+    obs_aggregate.write_merged_timeline(
+        str(out / "timeline.json"),
+        flight_dumps=[str(p) for p in dumps],
+        labels={str(p): (p.parent.name if p.name == "flight.jsonl"
+                         else "driver") for p in dumps})
+
     report = {
         "seed": args.seed,
+        "trace_id": ctx.trace_id,
+        "timeline": str(out / "timeline.json"),
+        "fleet_metrics": (str(out / "fleet_metrics.prom")
+                          if live else None),
         "plan": json.loads(plan.to_json()),
         "wall_seconds": round(wall, 2),
         "results": results,

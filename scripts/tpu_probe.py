@@ -37,6 +37,13 @@ import sys
 import tempfile
 import time
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from gameoflifewithactors_tpu.obs import flight as obs_flight  # noqa: E402
+from gameoflifewithactors_tpu.obs.registry import REGISTRY  # noqa: E402
+
 _CHILD = r"""
 import sys
 def stage(s):
@@ -44,6 +51,7 @@ def stage(s):
 stage("import-start")
 import jax
 stage("import-done")
+stage("init-start")
 devices = jax.devices()
 plat = devices[0].platform
 stage("devices-done %s %d" % (plat, len(devices)))
@@ -97,6 +105,7 @@ def probe(timeout: float = 60.0, env: dict | None = None) -> dict:
             "(none)": "wedged-import",   # never even reached import-start
             "import-start": "wedged-import",
             "import-done": "wedged-init",
+            "init-start": "wedged-init",  # backend/device init hung
             "devices-done": "wedged-compute",
         }.get(last, "wedged-compute")
         result["detail"] = f"child killed after {timeout}s; last stage: {last}"
@@ -106,6 +115,21 @@ def probe(timeout: float = 60.0, env: dict | None = None) -> dict:
         result["detail"] = stages[-1]
     else:
         result["detail"] = f"child rc={rc}; last stage: {last}; stderr: {err_tail}"
+    # the outcome is fleet evidence, not just a return value: a counter
+    # per status for the aggregated /metrics view, and a flight event so
+    # a later dump shows when the tunnel wedged relative to the run
+    REGISTRY.counter("tpu_probe_total",
+                     "tunnel health probes run, by outcome"
+                     ).inc(status=result["status"])
+    if result["status"].startswith("wedged"):
+        REGISTRY.counter("tpu_probe_wedged_total",
+                         "probes that found the tunnel wedged, by the "
+                         "last stage the child reached"
+                         ).inc(stage=last)
+    obs_flight.note_event(
+        "tpu_probe", {"status": result["status"], "last_stage": last,
+                      "platform": result["platform"],
+                      "elapsed_s": result["elapsed_s"]})
     return result
 
 

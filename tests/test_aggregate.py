@@ -1,0 +1,265 @@
+"""Fleet aggregation (obs/aggregate.py): merged metrics that never lie,
+merged timelines that never reorder.
+
+The claims under test (README "Fleet observability"):
+
+- **no silent summing** — ``merge_expositions`` tags every series with
+  its ``proc`` label and preserves it; ``sum_across_procs`` REFUSES
+  per-chip gauges (the COST check: a summed per-chip rate is a fleet
+  number no chip produced);
+- **clock alignment** — two subprocess tapes whose ``perf_counter``
+  origins differ by minutes merge into one monotonic epoch timeline via
+  each process's wall↔perf anchor; tapes without an anchor land under
+  ``unaligned``, never at a fabricated time;
+- **provenance** — flight-dump trigger headers survive the merge
+  verbatim; a live scrape of N workers yields one exposition with one
+  row per worker, and a down worker is an absent row, not a zero.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from gameoflifewithactors_tpu.obs.aggregate import (
+    AggregatorServer,
+    FleetAggregator,
+    PerChipSumError,
+    base_name,
+    merge_expositions,
+    merge_flight_dumps,
+    merge_timelines,
+    parse_exposition,
+    series_across_procs,
+    sum_across_procs,
+    validate_timeline,
+    write_merged_timeline,
+)
+from gameoflifewithactors_tpu.obs.exporter import render_prometheus, serve_metrics
+from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+
+# -- exposition merge ---------------------------------------------------------
+
+
+def _exposition(**series) -> str:
+    reg = MetricsRegistry()
+    for name, value in series.items():
+        if name.endswith("_total"):
+            reg.counter(name, "a counter").inc(value)
+        else:
+            reg.gauge(name, "a gauge").set(value)
+    return render_prometheus(reg.snapshot())
+
+
+def test_merge_expositions_labels_every_series_with_proc():
+    merged = merge_expositions({
+        "w0": _exposition(session_steps_total=5, tenant_steps_per_sec=10.5),
+        "w1": _exposition(session_steps_total=7, tenant_steps_per_sec=3.25),
+    })
+    parsed = parse_exposition(merged)
+    procs = {labels["proc"] for _n, labels, _v in parsed["samples"]}
+    assert procs == {"w0", "w1"}
+    rows = series_across_procs({"w0": merged}, "tenant_steps_per_sec")
+    assert sorted(v for _p, _l, v in rows) == [3.25, 10.5]
+
+
+def test_merge_expositions_preserves_histogram_families():
+    reg = MetricsRegistry()
+    h = reg.histogram("session_phase_seconds", "phases")
+    h.observe(0.01, phase="admission", tenant="t0")
+    h.observe(0.5, phase="dispatch", tenant="t0")
+    merged = merge_expositions(
+        {"w0": render_prometheus(reg.snapshot())})
+    parsed = parse_exposition(merged)
+    names = {n for n, _l, _v in parsed["samples"]}
+    fam = "goltpu_session_phase_seconds"
+    assert {f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"} <= names
+    # cumulative le buckets survive per proc, still a valid histogram
+    assert parsed["types"][fam] == "histogram"
+    counts = [(labels, v) for n, labels, v in parsed["samples"]
+              if n == f"{fam}_count"]
+    assert all(labels["proc"] == "w0" for labels, _v in counts)
+
+
+def test_merge_expositions_refuses_preexisting_proc_label():
+    merged = merge_expositions({"w0": _exposition(session_steps_total=1)})
+    with pytest.raises(ValueError, match="proc label"):
+        merge_expositions({"again": merged})
+
+
+def test_sum_across_procs_refuses_per_chip_gauges():
+    per_proc = {
+        "w0": _exposition(session_steps_total=5, tenant_steps_per_sec=10.0,
+                          hbm_bytes_in_use=2 ** 20),
+        "w1": _exposition(session_steps_total=7, tenant_steps_per_sec=3.0,
+                          hbm_bytes_in_use=2 ** 21),
+    }
+    # additive counters sum fine
+    assert sum_across_procs(per_proc, "session_steps_total") == 12.0
+    # per-chip gauges refuse: the honest view is the per-proc series
+    for name in ("tenant_steps_per_sec", "hbm_bytes_in_use"):
+        with pytest.raises(PerChipSumError, match="per-chip"):
+            sum_across_procs(per_proc, name)
+    assert len(series_across_procs(per_proc, "tenant_steps_per_sec")) == 2
+
+
+def test_base_name_strips_prefix_and_histogram_suffixes():
+    assert base_name("goltpu_session_phase_seconds_bucket") == \
+        "session_phase_seconds"
+    assert base_name("goltpu_sessions_live") == "sessions_live"
+    assert base_name("plain_count") == "plain"
+
+
+# -- timeline merge -----------------------------------------------------------
+
+
+def _write_dump(path, *, anchor, spans=(), events=(), reason="test",
+                trace_id=None, pid=1234):
+    """A fabricated flight dump: the exact JSONL shape
+    FlightRecorder.dump writes (tests/test_obs.py pins that shape)."""
+    header = {"type": "flight", "schema_version": 1, "reason": reason,
+              "pid": pid, "epoch_anchor": anchor, "trace_id": trace_id}
+    if anchor is None:
+        del header["epoch_anchor"]
+    lines = [json.dumps(header)]
+    lines += [json.dumps({"type": "span", **s}) for s in spans]
+    lines += [json.dumps({"type": "event", **e}) for e in events]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_skewed_clocks_merge_monotonic(tmp_path):
+    # process A booted long ago: large perf_counter, small anchor;
+    # process B booted just now: tiny perf_counter, larger anchor. On
+    # raw perf_counter, B's span would sort before A's — wall order is
+    # the reverse.
+    a = _write_dump(tmp_path / "a.jsonl", anchor=1000.0, pid=1,
+                    spans=[{"name": "a.late", "t0": 100.0, "t1": 101.0,
+                            "thread": "main"}])
+    b = _write_dump(tmp_path / "b.jsonl", anchor=1090.0, pid=2,
+                    spans=[{"name": "b.early", "t0": 5.0, "t1": 6.0,
+                            "thread": "main"}],
+                    events=[{"kind": "kill", "t": 5.5, "thread": "main"}])
+    merged = merge_flight_dumps([a, b])
+    timed = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert [e["name"] for e in timed] == ["b.early", "kill", "a.late"]
+    assert validate_timeline(merged) == []
+    # wall = perf + anchor, microseconds
+    assert timed[0]["ts"] == pytest.approx((5.0 + 1090.0) * 1e6)
+    assert timed[-1]["ts"] == pytest.approx((100.0 + 1000.0) * 1e6)
+
+
+def test_merge_preserves_trigger_headers_verbatim(tmp_path):
+    a = _write_dump(tmp_path / "a.jsonl", anchor=10.0, pid=1,
+                    reason="peer lost (heartbeat): [2]",
+                    trace_id="ab" * 16)
+    merged = merge_flight_dumps([a])
+    hdr = merged["flight_headers"]["a"]
+    assert hdr["reason"] == "peer lost (heartbeat): [2]"
+    assert hdr["trace_id"] == "ab" * 16
+    assert hdr["pid"] == 1
+
+
+def test_anchorless_dump_lands_in_unaligned_not_misplaced(tmp_path):
+    old = _write_dump(tmp_path / "old.jsonl", anchor=None, pid=9,
+                      spans=[{"name": "old.span", "t0": 1.0, "t1": 2.0,
+                              "thread": "main"}])
+    new = _write_dump(tmp_path / "new.jsonl", anchor=50.0, pid=2,
+                      spans=[{"name": "new.span", "t0": 1.0, "t1": 2.0,
+                              "thread": "main"}])
+    merged = merge_flight_dumps([old, new])
+    assert merged["unaligned"] == ["old"]
+    names = [e["name"] for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert names == ["new.span"]  # nothing placed at a fabricated time
+    assert "old" in merged["flight_headers"]  # provenance still kept
+
+
+def test_validate_timeline_flags_negative_and_out_of_order():
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "n1", "ts": 100.0, "dur": -5.0},
+        {"ph": "X", "name": "n2", "ts": 50.0, "dur": 1.0},
+    ]}
+    problems = validate_timeline(bad)
+    assert any("negative duration" in p for p in problems)
+    assert any("out-of-order" in p for p in problems)
+
+
+def test_write_merged_timeline_roundtrip(tmp_path):
+    a = _write_dump(tmp_path / "w0.jsonl", anchor=5.0, pid=1,
+                    spans=[{"name": "s", "t0": 1.0, "t1": 2.0,
+                            "thread": "main", "trace_id": "cd" * 16,
+                            "span_id": "11" * 8, "parent_id": "22" * 8}])
+    out = write_merged_timeline(str(tmp_path / "timeline.json"),
+                                flight_dumps=[a])
+    merged = json.loads((tmp_path / "timeline.json").read_text())
+    assert out.endswith("timeline.json")
+    span = [e for e in merged["traceEvents"] if e["ph"] == "X"][0]
+    # trace ids ride along into the chrome-trace args
+    assert span["args"]["trace_id"] == "cd" * 16
+    assert span["args"]["parent_id"] == "22" * 8
+    assert validate_timeline(merged) == []
+
+
+def test_merge_timelines_unions_extras():
+    t1 = {"traceEvents": [{"ph": "X", "name": "a", "ts": 2.0, "dur": 1.0}],
+          "flight_headers": {"w0": {"reason": "r0"}}}
+    t2 = {"traceEvents": [{"ph": "M", "pid": 1, "tid": 0,
+                           "name": "process_name", "args": {"name": "d"}},
+                          {"ph": "X", "name": "b", "ts": 1.0, "dur": 1.0}],
+          "unaligned": ["legacy"]}
+    merged = merge_timelines([t1, t2])
+    timed = [e["name"] for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert timed == ["b", "a"]  # re-sorted across sources
+    assert merged["traceEvents"][0]["ph"] == "M"  # meta stays first
+    assert merged["flight_headers"] == {"w0": {"reason": "r0"}}
+    assert merged["unaligned"] == ["legacy"]
+
+
+# -- live scraping ------------------------------------------------------------
+
+
+def test_fleet_aggregator_scrapes_labels_and_tolerates_down(tmp_path):
+    regs = {name: MetricsRegistry() for name in ("w0", "w1")}
+    regs["w0"].counter("session_steps_total", "steps").inc(3)
+    regs["w1"].counter("session_steps_total", "steps").inc(4)
+    servers = {name: serve_metrics(0, registry=reg)
+               for name, reg in regs.items()}
+    try:
+        targets = {name: f"127.0.0.1:{srv.port}"
+                   for name, srv in servers.items()}
+        targets["w2"] = "127.0.0.1:1"  # nothing listens there
+        agg = FleetAggregator(targets, ttl_seconds=0.0)
+        assert agg.up() == {"w0": True, "w1": True, "w2": False}
+        merged = agg.render()
+        parsed = parse_exposition(merged)
+        rows = [(labels["proc"], v) for n, labels, v in parsed["samples"]
+                if n == "goltpu_session_steps_total"]
+        # one row per live worker; the down one is absent, not zero
+        assert sorted(rows) == [("w0", 3.0), ("w1", 4.0)]
+        with AggregatorServer(agg, 0) as front:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/metrics",
+                    timeout=5) as r:
+                assert 'proc="w1"' in r.read().decode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/fleet", timeout=5) as r:
+                assert json.loads(r.read())["up"]["w2"] is False
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_fleet_aggregator_ttl_cache_coalesces(tmp_path):
+    calls = []
+
+    class Probe(FleetAggregator):
+        def _fetch(self, url):
+            calls.append(url)
+            return "goltpu_x_total 1\n"
+
+    agg = Probe({"w0": "127.0.0.1:9"}, ttl_seconds=60.0)
+    agg.scrape()
+    agg.scrape()  # served from cache: no second fetch
+    assert len(calls) == 1
+    agg.scrape(force=True)
+    assert len(calls) == 2

@@ -47,7 +47,8 @@ def run_fixture(src: str, path: str = "pkg/mod.py"):
 
 def test_rule_registry_is_complete_and_stable():
     assert sorted(RULES) == [
-        "GOL001", "GOL002", "GOL003", "GOL004", "GOL005", "GOL006"]
+        "GOL001", "GOL002", "GOL003", "GOL004", "GOL005", "GOL006",
+        "GOL007"]
     for rule in RULES.values():
         assert rule.name and rule.summary
 
@@ -278,6 +279,60 @@ def test_gol006_tracked_jit_is_clean():
         run = tracked_jit(lambda x: x, runner="r")
     """)
     assert codes(rep) == []
+
+
+# -- GOL007: obs/ scrape-cache read discipline --------------------------------
+
+
+_CACHED_CLS = """
+    import threading
+
+    class Agg:
+        def __init__(self):
+            self._cache = None
+            self._lock = threading.Lock()
+
+        def scrape(self):
+            {body}
+"""
+
+
+def test_gol007_positive_lock_free_cache_read():
+    rep = run_fixture(
+        textwrap.dedent(_CACHED_CLS).format(body="return self._cache"),
+        path="pkg/obs/agg.py")
+    assert codes(rep, "GOL007") == ["GOL007"]
+
+
+def test_gol007_negative_snapshot_under_lock():
+    body = ("with self._lock:\n"
+            "                c = self._cache\n"
+            "            return c")
+    rep = run_fixture(
+        textwrap.dedent(_CACHED_CLS).format(body=body),
+        path="pkg/obs/agg.py")
+    assert codes(rep, "GOL007") == []
+
+
+def test_gol007_out_of_scope_paths_and_attrs_are_exempt():
+    # same slip outside obs/ is out of scope for this rule
+    rep = run_fixture(
+        textwrap.dedent(_CACHED_CLS).format(body="return self._cache"),
+        path="pkg/serve/agg.py")
+    assert codes(rep, "GOL007") == []
+    # non-cache attrs are GOL004's (write-side) business, not GOL007's
+    rep = run_fixture("""
+        import threading
+
+        class Rec:
+            def __init__(self):
+                self._events = []
+                self._lock = threading.Lock()
+
+            def peek(self):
+                return self._events
+    """, path="pkg/obs/rec.py")
+    assert codes(rep, "GOL007") == []
 
 
 # -- pragmas ------------------------------------------------------------------
