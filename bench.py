@@ -626,10 +626,19 @@ def main() -> None:
     persisted = _load_persisted(key)
     if persisted is not None:
         prov = _provenance().staleness(persisted)
+        # the staleness verdict prints ONCE (the WARNING line below) and
+        # lands in the served JSON once (stale_reason) — not echoed again
+        # on the "using persisted" line or the NEEDS RECAPTURE tail
+        # (BENCH_r05's tail carried the measured-paths diff twice)
+        note = "" if prov["stale"] else f" ({prov['reason']})"
         sys.stderr.write(
-            f"using persisted TPU measurement recorded at {persisted.get('recorded_at')}"
-            f" ({prov['reason']})\n")
-        out = {**persisted, "persisted": True}
+            f"using persisted TPU measurement recorded at "
+            f"{persisted.get('recorded_at')}{note}\n")
+        # re-derive staleness from HEAD on every serve: flags a previous
+        # serve baked into the persisted file must not leak through
+        out = {k: v for k, v in persisted.items()
+               if k not in ("stale", "stale_reason", "needs_recapture")}
+        out["persisted"] = True
         if prov["stale"]:
             # the measured code path changed since this record's commit:
             # the number describes a PREDECESSOR of HEAD's kernel. Serve it
@@ -648,9 +657,9 @@ def main() -> None:
             sys.stderr.write(
                 f"NEEDS RECAPTURE: vs_baseline={out.get('vs_baseline', 0):.3g} "
                 f"above is a STALE persisted TPU record "
-                f"(@{out.get('commit', '?')}, {out.get('recorded_at', '?')}); "
-                f"{prov['reason']}. Re-run bench.py in a healthy tunnel "
-                "window before citing it.\n")
+                f"(@{out.get('commit', '?')}, {out.get('recorded_at', '?')}; "
+                "stale_reason in the JSON above). Re-run bench.py in a "
+                "healthy tunnel window before citing it.\n")
         return
 
     # when the tunnel is wedged the axon PJRT plugin hangs `import jax`

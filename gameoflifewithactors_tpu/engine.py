@@ -285,6 +285,7 @@ class Engine:
         self._sparse = None
         self._flags = None
         self._sparse_tiles = None
+        self._ghost_pipeline = False  # width-g overlapped pipeline in use
         if mesh is not None:
             # validate in *cell* units before packing, so the error names the
             # user's grid shape, not the packed word shape
@@ -451,12 +452,28 @@ class Engine:
                 self._run = make(mesh, self.rule, topology, donate=True)
                 if gens_per_exchange > 1 and backend == "packed":
                     # communication-avoiding: bulk generations go through
-                    # the depth-g runner; n % g remainders use the per-gen
-                    # runner built above
-                    deep = sharded.make_multi_step_packed_deep(
-                        mesh, self.rule, topology,
-                        gens_per_exchange=gens_per_exchange, donate=True)
-                    self._run = _chunked(deep, self._run, gens_per_exchange)
+                    # the width-g ghost-zone pipeline (boundary-first
+                    # compute, exchange overlapping the interior) when the
+                    # per-device tile can host its 2g-row / 2·ceil(g/32)-
+                    # word rings; tiles too small for overlap fall back to
+                    # the plain depth-g runner. n % g remainders use the
+                    # per-gen runner built above either way.
+                    nx = mesh.shape[mesh_lib.ROW_AXIS]
+                    ny = mesh.shape[mesh_lib.COL_AXIS]
+                    if mesh_lib.ghost_fits(state.shape[0] // nx,
+                                           state.shape[1] // ny,
+                                           gens_per_exchange):
+                        bulk = sharded.make_multi_step_packed_ghost(
+                            mesh, self.rule, topology,
+                            gens_per_exchange=gens_per_exchange,
+                            donate=True)
+                        self._ghost_pipeline = True
+                    else:
+                        bulk = sharded.make_multi_step_packed_deep(
+                            mesh, self.rule, topology,
+                            gens_per_exchange=gens_per_exchange,
+                            donate=True)
+                    self._run = _chunked(bulk, self._run, gens_per_exchange)
         elif backend == "sparse":
             from .ops.sparse import (
                 DEFAULT_TILE_ROWS,
@@ -913,9 +930,12 @@ class Engine:
             col_strip = b * (h // nx + 2 * depth * g) * itemsize
         elif g > 1:
             # communication-avoiding runner: one exchange of g-deep row
-            # strips + 1-word column strips per g generations, amortized
+            # strips + ceil(g/32)-word column strips per g generations,
+            # amortized (the ghost pipeline widens the word halo past
+            # g = 32; the deep fallback is always 1 word, same formula)
+            hw = mesh_lib.ghost_halo_words(g) if self._ghost_pipeline else 1
             row_strip = g * (wq // ny) * itemsize
-            col_strip = 1 * (h // nx + 2 * g) * itemsize
+            col_strip = hw * (h // nx + 2 * g) * itemsize
         else:
             row_strip = depth * (wq // ny) * itemsize  # d rows of one tile
             # d columns of a row-extended (h + 2d rows) tile

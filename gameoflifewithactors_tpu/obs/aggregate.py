@@ -47,6 +47,10 @@ PER_CHIP_GAUGES = frozenset({
     "hbm_bytes_in_use", "hbm_bytes_peak", "hbm_bytes_limit",
     "tenant_steps_per_sec", "worker_steps_per_sec",
     "cell_updates_per_sec",
+    # overlap efficiency is a ratio of one chip's block schedule; a
+    # fleet "sum of ratios" is meaningless. The halo *totals*
+    # (halo_bytes_total, halo_exchanges_total) are counters and sum.
+    "halo_overlap_ratio",
 })
 
 

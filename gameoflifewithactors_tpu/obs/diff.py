@@ -29,6 +29,7 @@ LOWER = "lower_is_better"
 # bands; the headline rates are the contract — tighter bands.
 DEFAULT_TOLERANCES = (
     ("bench/value", 0.20),
+    ("scaling/single_chip_equivalent_updates_per_sec", 0.25),
     ("step/best_cell_updates_per_sec", 0.25),
     ("step/seconds_per_gen", 0.35),
     ("compile/", 2.0),     # cache state dominates; only gross blowups gate
@@ -79,6 +80,15 @@ def extract_metrics(record: dict) -> Dict[str, dict]:
             out["bench/value"] = {"value": float(record["value"]),
                                   "direction": HIGHER,
                                   "label": record["metric"]}
+        # weak-scaling COST records (scripts/weak_scaling.py --out) ride
+        # the bench shape plus the per-chip-equivalent headline: the
+        # fleet's rate per device, in single-chip-bench units, at the
+        # largest device count measured
+        sceq = record.get("single_chip_equivalent_updates_per_sec")
+        if isinstance(sceq, (int, float)):
+            out["scaling/single_chip_equivalent_updates_per_sec"] = {
+                "value": float(sceq), "direction": HIGHER,
+                "label": "per-chip-equivalent updates/sec"}
         return out
     steps = record.get("step_metrics") or []
     rates = [m.get("cell_updates_per_sec") for m in steps

@@ -80,6 +80,35 @@ def exchange_cols(ext: jax.Array, ny: int, topology: Topology, axis: str = COL_A
     return jnp.concatenate([west, ext, east], axis=1)
 
 
+def exchange_rows_parts(top: jax.Array, bottom: jax.Array, nx: int,
+                        topology: Topology,
+                        axis=ROW_AXIS) -> Tuple[jax.Array, jax.Array]:
+    """Row phase of a *split* two-phase exchange: given MY top and bottom
+    d-row strips, return ``(north_halo, south_halo)`` — the strips my
+    neighbors just sent me. Identical wire traffic and direction contract
+    to :func:`exchange_rows` (my north halo is my north neighbor's bottom
+    strip), but the caller supplies the strips instead of the whole tile,
+    so the ghost-zone runner can issue the sends from freshly-computed
+    boundary rings while the tile interior is still being stepped."""
+    wrap = topology is Topology.TORUS
+    north = lax.ppermute(bottom, axis, _shift_perm(nx, +1, wrap))
+    south = lax.ppermute(top, axis, _shift_perm(nx, -1, wrap))
+    return north, south
+
+
+def exchange_cols_parts(west_cols: jax.Array, east_cols: jax.Array, ny: int,
+                        topology: Topology,
+                        axis: str = COL_AXIS) -> Tuple[jax.Array, jax.Array]:
+    """Column phase of a split two-phase exchange: given MY west and east
+    d-word columns *of the row-extended tile* (so the corner blocks ride
+    along, exactly as in :func:`exchange_cols`), return
+    ``(west_halo, east_halo)``."""
+    wrap = topology is Topology.TORUS
+    west = lax.ppermute(east_cols, axis, _shift_perm(ny, +1, wrap))
+    east = lax.ppermute(west_cols, axis, _shift_perm(ny, -1, wrap))
+    return west, east
+
+
 def exchange_rows_stack(stack: jax.Array, nx: int, topology: Topology,
                         axis=ROW_AXIS, depth: int = 1) -> jax.Array:
     """(b, h, w) stack -> (b, h+2d, w): the row half of

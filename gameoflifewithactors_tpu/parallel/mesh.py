@@ -155,6 +155,58 @@ def band_axis(mesh: Mesh):
     return axis, nx * ny
 
 
+def ghost_halo_words(gens_per_exchange: int) -> int:
+    """East/west ghost-zone depth in packed words for a width-k pipeline:
+    ``ceil(k / 32)`` (ops/bitpack.py WORD). Horizontal edge corruption
+    creeps 1 cell per in-block generation, so k generations need k cells
+    = this many whole words of halo per side — word granularity is what
+    lifts the old g <= 32 cap of the 1-word deep runner."""
+    from ..ops import bitpack
+
+    return -(-int(gens_per_exchange) // bitpack.WORD)
+
+
+def ghost_fits(tile_rows: int, tile_words: int,
+               gens_per_exchange: int) -> bool:
+    """Whether a (tile_rows, tile_words) per-device packed tile can run
+    the width-k ghost-zone pipeline: the boundary rings consumed per
+    block are 2k rows and 2·ceil(k/32) words deep, and both must fit
+    inside the tile (k > tile capacity is refused, not clamped)."""
+    k = int(gens_per_exchange)
+    if k < 1:
+        return False
+    hw = ghost_halo_words(k)
+    return 2 * k <= int(tile_rows) and 2 * hw <= int(tile_words)
+
+
+def best_mesh_shape(n: int, rows: int, words: int, *,
+                    gens_per_exchange: int = 1) -> Optional[Tuple[int, int]]:
+    """Most-square (nx, ny) factorization of ``n`` devices that divides a
+    packed (rows, words) grid AND leaves tiles deep/wide enough for a
+    width-``gens_per_exchange`` ghost-zone pipeline
+    (``gens_per_exchange=0`` skips the capacity constraint — plain
+    divisibility, for lock-step per-generation exchange). Deterministic
+    in its inputs, so every process of a multi-controller fleet computes
+    the same shape from the same roster — the elastic runtime's
+    re-tiling decision after a shrink lives here, not in per-worker
+    state. Returns None when no factorization fits (callers fall back to
+    lock-step bands)."""
+    best = None
+    for nx in range(1, n + 1):
+        if n % nx:
+            continue
+        ny = n // nx
+        if rows % nx or words % ny:
+            continue
+        if (gens_per_exchange >= 1
+                and not ghost_fits(rows // nx, words // ny,
+                                   gens_per_exchange)):
+            continue
+        if best is None or abs(nx - ny) < abs(best[0] - best[1]):
+            best = (nx, ny)
+    return best
+
+
 def grid_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding that tiles a (H, W) or (H, W/32) grid 2D over the mesh."""
     return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))

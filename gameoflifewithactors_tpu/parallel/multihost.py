@@ -110,6 +110,44 @@ def global_mesh(shape: Optional[Tuple[int, int]] = None,
     return make_mesh(shape, list(devices if devices is not None else jax.devices()))
 
 
+def global_mesh_for_grid(
+    grid_shape: Tuple[int, int],
+    preferred: Optional[Tuple[int, int]] = None,
+    *,
+    gens_per_exchange: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The fleet's mesh for a packed (rows, words) grid: ``preferred``
+    when it fits the current global roster (device count, divisibility,
+    and — for a width-k ghost pipeline — tile capacity), else the
+    most-square valid factorization, else lock-step (n, 1) bands.
+
+    This is THE re-tiling decision of the elastic runtime: every
+    surviving process calls it with the same global inputs after a
+    shrink/replace epoch, so all controllers deterministically agree on
+    where the 2D tiles land before ``put_global_grid`` re-places them.
+    """
+    from .mesh import best_mesh_shape, ghost_fits
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    k = int(gens_per_exchange)
+    rows, words = int(grid_shape[0]), int(grid_shape[1])
+    if preferred is not None:
+        mx, my = preferred
+        if (mx * my == n and rows % mx == 0 and words % my == 0
+                and (k <= 1 or ghost_fits(rows // mx, words // my, k))):
+            return global_mesh((mx, my), devices)
+    shape = None
+    if k > 1:
+        shape = best_mesh_shape(n, rows, words, gens_per_exchange=k)
+    if shape is None:
+        # no ghost-capable tiling: fall back to plain divisibility
+        # (lock-step per-gen exchange), then to legacy (n, 1) bands
+        shape = best_mesh_shape(n, rows, words, gens_per_exchange=0)
+    return global_mesh(shape if shape is not None else (n, 1), devices)
+
+
 def put_global_grid(grid: np.ndarray, mesh: Mesh,
                     banded: bool = False) -> jax.Array:
     """Place a host grid (same full copy on every process) onto ``mesh``.

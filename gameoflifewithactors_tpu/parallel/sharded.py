@@ -526,6 +526,255 @@ def make_multi_step_packed_deep(
     return _tracked(_run, "sharded.multi_step_packed_deep", donate)
 
 
+def ghost_exchange_bytes(grid_shape, mesh: Mesh, topology: Topology,
+                         gens_per_exchange: int) -> int:
+    """Interconnect bytes ONE ghost-zone exchange moves fleet-wide for a
+    packed (H, Wp) grid on ``mesh``: 2 row strips of k rows per
+    row-neighbor pair plus 2 column strips of ceil(k/32) words (of the
+    row-extended tile) per column-neighbor pair. Self-sends on a
+    size-1 TORUS axis stay on-device and count zero, matching
+    utils/profiling.collective_permute_bytes. This is the model side of
+    the byte-accounting tests and the ``halo_bytes_total`` counter."""
+    from .mesh import ghost_halo_words
+
+    k = int(gens_per_exchange)
+    hw = ghost_halo_words(k)
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    h, wq = int(grid_shape[-2]) // nx, int(grid_shape[-1]) // ny
+    itemsize = 4  # packed uint32 words
+    wrap = topology is Topology.TORUS
+    row_sends = (2 * ny * (nx if wrap else nx - 1)) if nx > 1 else 0
+    col_sends = (2 * nx * (ny if wrap else ny - 1)) if ny > 1 else 0
+    return (row_sends * k * wq * itemsize
+            + col_sends * hw * (h + 2 * k) * itemsize)
+
+
+def make_multi_step_packed_ghost(
+    mesh: Mesh,
+    rule: Rule,
+    topology: Topology = Topology.TORUS,
+    gens_per_exchange: int = 8,
+    donate: bool = False,
+    *,
+    unroll_chunks: "int | None" = None,
+) -> Callable:
+    """Width-k ghost-zone pipeline on the 2D mesh: ONE halo exchange per
+    k generations, issued so the ppermutes overlap interior compute.
+
+    Where :func:`make_multi_step_packed_deep` re-exchanges the whole tile
+    every chunk (compute idles while halos fly), this runner splits each
+    k-generation block into *boundary-first* dataflow:
+
+    1. advance the four boundary rings of the NEXT tile first — four
+       shrinking slabs (ops/packed.py step_packed_slab) over the halo-
+       extended edges, each cropped to its proven-exact core;
+    2. issue the NEXT block's exchange from those fresh rings
+       (halo.exchange_rows_parts / exchange_cols_parts — same two-phase
+       corner contract as exchange_halo, column strips assembled from
+       the row-extended edges so corners ride phase 2);
+    3. only then advance the tile interior k generations — a subgraph
+       with no data dependency on the in-flight ppermutes, so XLA is
+       free to run the collectives and the interior stencil
+       concurrently.
+
+    Ghost-zone widths: k halo rows vertically (the shrinking slab
+    consumes them exactly) and ``ceil(k/32)`` halo *words* horizontally
+    (edge corruption creeps 1 cell per generation; whole words keep the
+    packed layout aligned). That word granularity removes deep's
+    g <= 32 cap — k is bounded only by the tile: 2k rows and
+    2·ceil(k/32) words must fit (refused at trace time otherwise, see
+    parallel/mesh.ghost_fits). DEAD topology re-zeroes the permanently-
+    dead exterior of global-edge tiles before every in-block generation,
+    exactly like the deep runner.
+
+    Exchange count is structural: a run of ``chunks`` blocks performs
+    exactly ``chunks`` exchanges (one prologue + one per non-final
+    block; the final block computes straight out of its halos) — exactly
+    k× fewer than the lock-step runner over the same k·chunks
+    generations, provable from compiled HLO via
+    utils/profiling.collective_permute_count on an unrolled build
+    (``unroll_chunks=c`` swaps the traced-chunks fori_loop for c static
+    blocks so the collectives are countable).
+
+    Every call bumps the fleet observability plane: ``halo_exchanges_
+    total``, ``halo_bytes_total`` (modeled interconnect bytes, matching
+    collective_permute_bytes) and the per-chip ``halo_overlap_ratio``
+    gauge (fraction of each block's stencil work that is interior — the
+    share eligible to hide behind the in-flight exchange).
+
+    Returns jitted ``(grid, chunks) -> grid`` advancing ``chunks * k``
+    generations (``chunks`` traced, k static; chunks >= 1).
+    Bit-identity with the single-device oracle is enforced in
+    tests/test_ghost.py for TORUS and DEAD on band and 2D meshes.
+    """
+    from .halo import exchange_cols_parts, exchange_rows_parts
+    from .mesh import ghost_halo_words
+
+    k = int(gens_per_exchange)
+    if k < 1:
+        raise ValueError(f"gens_per_exchange must be >= 1, got {k}")
+    hw = ghost_halo_words(k)
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+
+    def _zero_exterior(slab, *, top=0, bottom=0, left=0, right=0):
+        # DEAD topology: the exterior is *permanently* dead but a slab
+        # advance would evolve it (same feedback failure the deep runner
+        # guards). top/bottom/left/right = exterior rows/words still
+        # present at each edge of THIS slab; masked by the device's
+        # global-edge position so interior tiles evolve halos freely.
+        rows = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, slab.shape, 1)
+        ix = jax.lax.axis_index(ROW_AXIS)
+        iy = jax.lax.axis_index(COL_AXIS)
+        mask = jnp.zeros(slab.shape, bool)
+        if top:
+            mask |= (ix == 0) & (rows < top)
+        if bottom:
+            mask |= (ix == nx - 1) & (rows >= slab.shape[0] - bottom)
+        if left:
+            mask |= (iy == 0) & (cols < left)
+        if right:
+            mask |= (iy == ny - 1) & (cols >= slab.shape[1] - right)
+        return jnp.where(mask, jnp.uint32(0), slab)
+
+    def _advance(slab, *, vtop=False, vbot=False, left=False, right=False):
+        # k shrinking-slab generations with DEAD closure; the v*/left/
+        # right flags say which edges of THIS slab carry exterior halo
+        # (vertical depth shrinks with the slab, word depth is constant)
+        for j in range(k):  # unrolled: the slab shape shrinks every gen
+            if topology is Topology.DEAD:
+                slab = _zero_exterior(
+                    slab,
+                    top=(k - j) if vtop else 0,
+                    bottom=(k - j) if vbot else 0,
+                    left=hw if left else 0,
+                    right=hw if right else 0)
+            slab = packed_ops.step_packed_slab(slab, rule, Topology.DEAD)
+        return slab
+
+    def _block(ext, exchange: bool):
+        # one k-generation block: (h+2k, w+2hw) haloed tile -> next
+        # haloed tile (exchange=True) or the bare (h, w) tile (final
+        # block). Boundary rings first, sends next, interior last.
+        eh, ew = ext.shape
+        h, w = eh - 2 * k, ew - 2 * hw
+        # -- 1. boundary rings of the NEXT tile -------------------------
+        # N/S strips span the full extended width (3k rows in, k out);
+        # W/E strips cover the remaining middle rows (3hw words in, hw
+        # out). Crops drop exactly the corruption-creep bound.
+        ring_n = _advance(ext[:3 * k, :], vtop=True,
+                          left=True, right=True)[:, hw:hw + w]
+        ring_s = _advance(ext[eh - 3 * k:, :], vbot=True,
+                          left=True, right=True)[:, hw:hw + w]
+        ring_w = _advance(ext[k:k + h, :3 * hw], left=True)[:, hw:2 * hw]
+        ring_e = _advance(ext[k:k + h, ew - 3 * hw:], right=True)[:, hw:2 * hw]
+        # -- 2. next exchange, fed ONLY by the rings --------------------
+        if exchange:
+            north, south = exchange_rows_parts(ring_n, ring_s, nx, topology)
+            # column strips of the row-extended next tile, assembled
+            # from boundary pieces so the (k, hw) corners ride phase 2
+            wcol = jnp.concatenate([
+                north[:, :hw], ring_n[:, :hw], ring_w,
+                ring_s[:, :hw], south[:, :hw]], axis=0)
+            ecol = jnp.concatenate([
+                north[:, w - hw:], ring_n[:, w - hw:], ring_e,
+                ring_s[:, w - hw:], south[:, w - hw:]], axis=0)
+            west, east = exchange_cols_parts(wcol, ecol, ny, topology)
+        # -- 3. interior: independent of the in-flight ppermutes --------
+        interior = _advance(ext[k:k + h, hw:hw + w])[:, hw:w - hw]
+        tile = jnp.concatenate([
+            ring_n,
+            jnp.concatenate([ring_w, interior, ring_e], axis=1),
+            ring_s], axis=0)
+        if not exchange:
+            return tile
+        return jnp.concatenate([
+            west, jnp.concatenate([north, tile, south], axis=0), east],
+            axis=1)
+
+    def _prologue(tile):
+        h, w = tile.shape  # static: caught at trace time
+        if 2 * k > h or 2 * hw > w:
+            raise ValueError(
+                f"gens_per_exchange={k} needs a per-device tile of at "
+                f"least ({2 * k} rows, {2 * hw} words); tile is ({h}, {w})"
+                " — use a smaller k, a coarser mesh, or a bigger grid")
+        return exchange_cols(
+            exchange_rows(tile, nx, topology, depth=k), ny, topology,
+            depth=hw)
+
+    if unroll_chunks is not None:
+        c = int(unroll_chunks)
+        if c < 1:
+            raise ValueError(f"unroll_chunks must be >= 1, got {c}")
+
+        @partial(shard_map, mesh=mesh, in_specs=_SPEC, out_specs=_SPEC)
+        def _run_static(tile):
+            ext = _prologue(tile)
+            for _ in range(c - 1):
+                ext = _block(ext, exchange=True)
+            return _block(ext, exchange=False)
+
+        return _tracked(_run_static, "sharded.multi_step_packed_ghost",
+                        donate)
+
+    @partial(shard_map, mesh=mesh, in_specs=(_SPEC, P()), out_specs=_SPEC)
+    def _run(tile, chunks):
+        ext = _prologue(tile)
+        ext = jax.lax.fori_loop(
+            0, chunks - 1, lambda _, e: _block(e, exchange=True), ext)
+        return _block(ext, exchange=False)
+
+    jitted = _tracked(_run, "sharded.multi_step_packed_ghost", donate)
+
+    # local share of the fleet's per-exchange wire bytes: each process
+    # accounts its own devices so fleet-wide sums don't multiply-count
+    try:
+        local_frac = sum(
+            1 for d in mesh.devices.flat
+            if d.process_index == jax.process_index()) / (nx * ny)
+    except (AttributeError, RuntimeError):
+        local_frac = 1.0
+
+    def run_ghost(grid, chunks):
+        from ..obs.registry import REGISTRY
+
+        try:
+            c = int(chunks)
+        except TypeError:  # traced: no host-side accounting possible
+            c = None
+        if c is not None and c < 1:
+            # chunks=0 would still run the prologue exchange + final
+            # block (= one full chunk); refuse instead of surprising
+            raise ValueError(f"chunks must be >= 1, got {chunks}")
+        shape = grid.shape  # before the call: donation may free the buffer
+        out = jitted(grid, chunks)
+        if c is None:
+            return out
+        REGISTRY.counter(
+            "halo_exchanges_total",
+            "ghost-zone halo exchanges performed (one per k-generation "
+            "block)").inc(c)
+        REGISTRY.counter(
+            "halo_bytes_total",
+            "modeled interconnect bytes moved by ghost-zone halo "
+            "exchanges").inc(
+            c * ghost_exchange_bytes(shape, mesh, topology, k) * local_frac)
+        h, w = shape[-2] // nx, shape[-1] // ny
+        interior = sum((h - 2 * j) * w for j in range(k))
+        boundary = sum(2 * (3 * k - 2 * j) * (w + 2 * hw)
+                       + 2 * (h - 2 * j) * 3 * hw for j in range(k))
+        REGISTRY.gauge(
+            "halo_overlap_ratio",
+            "fraction of each ghost block's stencil work that is "
+            "interior (overlappable with the in-flight exchange); "
+            "per-chip").set(interior / (interior + boundary))
+        return out
+
+    run_ghost.lower = jitted.lower  # profiling lowers the real computation
+    return run_ghost
+
+
 def make_multi_step_pallas(
     mesh: Mesh,
     rule: Rule,

@@ -301,6 +301,15 @@ class ElasticSpec:
     rng_seed: int = 0
     random_fill: float = 0.33
     devices_per_process: int = 1
+    # preferred 2D tile decomposition (mesh rows, mesh cols) for the
+    # ghost-zone pipeline; None = lock-step (n, 1) bands. A preferred
+    # shape the surviving roster cannot host (device count, divisibility,
+    # tile capacity) degrades deterministically on every controller —
+    # parallel/multihost.global_mesh_for_grid is the one decision point.
+    mesh_shape: Optional[Tuple[int, int]] = None
+    # halo exchange once per k generations (width-k ghost zones); tiles
+    # too small for the pipeline fall back to lock-step per-gen exchange
+    gens_per_exchange: int = 1
     heartbeat_interval_seconds: float = 0.25
     heartbeat_deadline_seconds: float = 3.0
     barrier_deadline_seconds: float = 10.0
@@ -310,6 +319,8 @@ class ElasticSpec:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["shape"] = list(self.shape)
+        if self.mesh_shape is not None:
+            d["mesh_shape"] = list(self.mesh_shape)
         return d
 
     @classmethod
@@ -317,6 +328,8 @@ class ElasticSpec:
         known = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in d.items() if k in known}
         kwargs["shape"] = tuple(d.get("shape", cls.shape))
+        if d.get("mesh_shape") is not None:
+            kwargs["mesh_shape"] = tuple(d["mesh_shape"])
         return cls(**kwargs)
 
 
@@ -363,7 +376,18 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
 
     multihost.initialize(f"localhost:{port}", num_processes, process_id,
                          initialization_timeout=120)
-    mesh = multihost.global_mesh((len(jax.devices()), 1))
+    # packed grid dims decide the tiling; every process computes the
+    # same mesh from the same global roster + spec (the 2D re-tiling
+    # after a shrink/replace epoch is THIS call, nothing stateful)
+    grid_rows = spec.shape[0]
+    grid_words = -(-spec.shape[1] // 32)  # ops/bitpack.py WORD
+    kpe = max(1, int(spec.gens_per_exchange))
+    if spec.mesh_shape is not None or kpe > 1:
+        mesh = multihost.global_mesh_for_grid(
+            (grid_rows, grid_words), spec.mesh_shape,
+            gens_per_exchange=kpe)
+    else:
+        mesh = multihost.global_mesh((len(jax.devices()), 1))
 
     flight_dir = rundir / "flight"
     flight_dir.mkdir(parents=True, exist_ok=True)
@@ -418,16 +442,40 @@ def run_worker(rundir: "str | Path", spec: ElasticSpec, *, epoch: int,
     if state_np is None:
         state_np = bitpack.pack_np(initial_grid(spec))
     state_np = np.asarray(state_np, dtype=np.uint32)
+    state = multihost.put_global_grid(state_np, mesh)
+    from ..parallel import mesh as mesh_lib
+    nx = mesh.shape[mesh_lib.ROW_AXIS]
+    ny = mesh.shape[mesh_lib.COL_AXIS]
+    pergen = sharded.make_multi_step_packed(mesh, rule, topology)
+    use_ghost = kpe > 1 and mesh_lib.ghost_fits(
+        state_np.shape[0] // nx, state_np.shape[1] // ny, kpe)
+    if use_ghost:
+        # the ghost-zone pipeline is the compute core; n % k remainder
+        # generations (shrunk final chunks, odd resume points) take the
+        # per-gen runner so any chunk size stays bit-exact
+        ghost = sharded.make_multi_step_packed_ghost(
+            mesh, rule, topology, gens_per_exchange=kpe)
+
+        def runner(s, n):
+            blocks, rem = divmod(int(n), kpe)
+            if blocks:
+                s = ghost(s, blocks)
+            if rem:
+                s = pergen(s, rem)
+            return s
+    else:
+        runner = pergen
     # durable restore record: the chaos driver (and a human post-mortem)
-    # can see exactly which generations each worker refused and why,
-    # even when the worker goes on to finish cleanly (flight-recorder
-    # notes only reach disk on a dump)
+    # can see exactly which generations each worker refused and why —
+    # and where this epoch re-placed the 2D tiles — even when the worker
+    # goes on to finish cleanly (flight-recorder notes only reach disk
+    # on a dump)
     _write_json(rundir / "restore" / f"e{epoch:03d}-p{process_id:04d}.json",
                 {"resumed_generation": gen,
+                 "mesh": [nx, ny],
+                 "runner": "ghost" if use_ghost else "lockstep",
+                 "gens_per_exchange": kpe if use_ghost else 1,
                  "skipped": [[str(d), why[:300]] for d, why in skipped]})
-
-    state = multihost.put_global_grid(state_np, mesh)
-    runner = sharded.make_multi_step_packed(mesh, rule, topology)
 
     hb = Heartbeat(rundir, epoch, process_id,
                    spec.heartbeat_interval_seconds)
@@ -600,7 +648,29 @@ class ElasticFleet:
         if num_processes < 1:
             raise ValueError(f"num_processes must be >= 1, got {num_processes}")
         h = spec.shape[0]
-        if h % (num_processes * spec.devices_per_process):
+        wp = -(-spec.shape[1] // 32)  # packed words (ops/bitpack.py)
+        ndev = num_processes * spec.devices_per_process
+        if spec.mesh_shape is not None:
+            mx, my = spec.mesh_shape
+            if mx * my != ndev:
+                raise ValueError(
+                    f"mesh_shape {spec.mesh_shape} needs {mx * my} devices, "
+                    f"fleet has {num_processes} processes x "
+                    f"{spec.devices_per_process} devices")
+            if h % mx or wp % my:
+                raise ValueError(
+                    f"packed grid ({h}, {wp}) words not divisible by "
+                    f"mesh_shape {spec.mesh_shape}")
+            if spec.gens_per_exchange > 1:
+                from ..parallel.mesh import ghost_fits
+                if not ghost_fits(h // mx, wp // my,
+                                  spec.gens_per_exchange):
+                    raise ValueError(
+                        f"gens_per_exchange={spec.gens_per_exchange} does "
+                        f"not fit ({h // mx}, {wp // my})-word tiles of "
+                        f"mesh_shape {spec.mesh_shape}; ghost zones need "
+                        "2k rows and 2*ceil(k/32) words per tile")
+        elif h % ndev:
             raise ValueError(
                 f"grid rows {h} not divisible over {num_processes} "
                 f"processes x {spec.devices_per_process} devices")
@@ -843,12 +913,32 @@ class ElasticFleet:
         n_next = n - preempted
         if not self.replace_killed:
             n_next -= killed_like
-        # the mesh over the shrunk roster must still divide the grid;
-        # if it can't, keep the old size (replacements instead)
-        h = self.spec.shape[0]
-        while n_next >= 1 and h % (n_next * self.spec.devices_per_process):
+        # the mesh over the shrunk roster must still tile the grid the
+        # same way the workers will choose it (multihost.
+        # global_mesh_for_grid); if it can't, keep the old size
+        # (replacements instead)
+        while n_next >= 1 and not self._roster_tiles(n_next):
             n_next += 1
         return min(n_next, n) if n_next >= 1 else n
+
+    def _roster_tiles(self, n_procs: int) -> bool:
+        """Whether ``n_procs`` processes can host SOME valid mesh for the
+        spec's packed grid — mirroring the workers' deterministic mesh
+        choice, 2D factorizations included."""
+        spec = self.spec
+        h = spec.shape[0]
+        ndev = n_procs * spec.devices_per_process
+        if spec.mesh_shape is None and spec.gens_per_exchange <= 1:
+            return h % ndev == 0  # legacy lock-step (n, 1) bands
+        from ..parallel.mesh import best_mesh_shape
+        wp = -(-spec.shape[1] // 32)
+        if (spec.gens_per_exchange > 1
+                and best_mesh_shape(ndev, h, wp,
+                                    gens_per_exchange=spec.gens_per_exchange)):
+            return True
+        if best_mesh_shape(ndev, h, wp, gens_per_exchange=0):
+            return True
+        return h % ndev == 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:

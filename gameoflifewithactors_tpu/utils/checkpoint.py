@@ -254,6 +254,34 @@ def _index_to_json(index: Sequence, shape: Sequence[int]) -> List[List[int]]:
     return out
 
 
+def _check_index_bounds(index: Sequence[Sequence[int]],
+                        shard_shape: Sequence[int],
+                        global_shape: Sequence[int],
+                        what: str) -> None:
+    """A shard's ``[[start, stop], ...]`` index must lie inside the
+    global array and span exactly the shard's own shape, per dimension.
+    2D-mesh tiles shard BOTH axes, so a re-tiling bug (wrong word
+    column after an elastic epoch, say) shows up as an extent/shape
+    mismatch here instead of as silently clamped slices —
+    ``slice.indices`` in :func:`_index_to_json` clamps out-of-range
+    bounds, and :func:`_check_exact_cover` stops masking past 2^26
+    elements."""
+    if len(index) != len(global_shape) or len(shard_shape) != len(global_shape):
+        raise CheckpointCorruptError(
+            f"{what}: index {list(index)} / shape {list(shard_shape)} rank "
+            f"!= global array rank {len(global_shape)}")
+    for d, ((start, stop), n, dim) in enumerate(
+            zip(index, shard_shape, global_shape)):
+        if not (0 <= start <= stop <= dim):
+            raise CheckpointCorruptError(
+                f"{what}: dim {d} index [{start}, {stop}) out of bounds "
+                f"for global extent {dim}")
+        if stop - start != n:
+            raise CheckpointCorruptError(
+                f"{what}: dim {d} index [{start}, {stop}) covers "
+                f"{stop - start} elements but shard shape has {n}")
+
+
 def _crc32(data: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(data).tobytes()) & 0xFFFFFFFF
 
@@ -293,9 +321,12 @@ def write_shards(
                 f"shard {j} dtype {data.dtype} != checkpoint dtype {dtype}")
         key = f"s{j}"
         arrays[key] = data
+        idx = _index_to_json(index, global_shape)
+        _check_index_bounds(idx, data.shape, global_shape,
+                            f"process {process_id} shard {j}")
         entries.append({
             "key": key,
-            "index": _index_to_json(index, global_shape),
+            "index": idx,
             "shape": list(data.shape),
             "crc32": _crc32(data),
         })
@@ -427,11 +458,15 @@ def verify_sharded(gen_dir: "str | Path") -> dict:
     :class:`CheckpointCorruptError` naming the first bad shard."""
     gen_dir = Path(gen_dir)
     manifest = read_manifest(gen_dir)
+    global_shape = tuple(manifest["global_shape"])
     for sc in manifest["processes"]:
         path = gen_dir / sc["file"]
         try:
             with np.load(path, allow_pickle=False) as z:
                 for e in sc["shards"]:
+                    _check_index_bounds(
+                        e["index"], e["shape"], global_shape,
+                        f"{path.name}[{e['key']}]")
                     data = np.asarray(z[e["key"]])
                     if list(data.shape) != list(e["shape"]):
                         raise CheckpointCorruptError(

@@ -133,6 +133,29 @@ def collective_permute_bytes(hlo_text: str) -> int:
     return total
 
 
+def collective_permute_count(hlo_text: str) -> int:
+    """Number of collective-permute instructions in compiled HLO that
+    move data between distinct devices (instructions whose pairs are all
+    src == dst self-copies don't count — nothing touched the wire).
+
+    This is the *exchange-count* side of the accounting story: the ghost
+    pipeline's k× claim is that a statically-unrolled c-chunk build
+    (``make_multi_step_packed_ghost(..., unroll_chunks=c)``) compiles to
+    exactly 1/k the permutes of c·k unrolled lock-step generations —
+    proven from the HLO the compiler emits, not from the source. Unlike
+    :func:`collective_permute_bytes` this figure is NOT invariant under
+    XLA's collective-combining passes; compare builds compiled with the
+    same pipeline (as tests/test_ghost.py does)."""
+    count = 0
+    for m in _CP_RE.finditer(hlo_text):
+        for pair in m.group("pairs").split("},{"):
+            src, dst = pair.split(",")
+            if src.strip() != dst.strip():
+                count += 1
+                break
+    return count
+
+
 def measured_halo_bytes_per_gen(engine) -> int:
     """Compile the engine's *one-generation* sharded step and account its
     collective-permute traffic from the optimized HLO. Returns 0 for
@@ -190,9 +213,19 @@ def measured_halo_bytes_per_gen(engine) -> int:
         # per-generation runner's figure would overstate what this engine
         # actually moves
         g = engine.gens_per_exchange
-        step1 = sharded.make_multi_step_packed_deep(
-            engine.mesh, engine.rule, engine.topology, gens_per_exchange=g)
-        lowered = step1.lower(engine.state, 1)
+        if getattr(engine, "_ghost_pipeline", False):
+            # statically-unrolled single chunk: the dynamic-chunks build's
+            # HLO carries the exchange twice (prologue + fori_loop body),
+            # which would double-count one chunk's traffic
+            step1 = sharded.make_multi_step_packed_ghost(
+                engine.mesh, engine.rule, engine.topology,
+                gens_per_exchange=g, unroll_chunks=1)
+            lowered = step1.lower(engine.state)
+        else:
+            step1 = sharded.make_multi_step_packed_deep(
+                engine.mesh, engine.rule, engine.topology,
+                gens_per_exchange=g)
+            lowered = step1.lower(engine.state, 1)
         return -(-collective_permute_bytes(lowered.compile().as_text()) // g)
     elif engine._packed:
         step1 = sharded.make_step_packed(engine.mesh, engine.rule, engine.topology)

@@ -6,8 +6,14 @@ live localhost fleet — recovery must be bit-exact.
 
 Launches an :class:`~gameoflifewithactors_tpu.resilience.distributed.
 ElasticFleet` of N real OS processes (multi-controller JAX over
-localhost, torus-sharded grid, sharded v2 checkpoints) and executes a
-seeded :class:`FaultPlan` of the *driver-level* fault kinds:
+localhost, sharded v2 checkpoints) and executes a seeded
+:class:`FaultPlan` of the *driver-level* fault kinds. By default the
+fleet runs the width-k ghost-zone pipeline on a 2x2 device mesh
+(``--mesh 2x2 --gens-per-exchange 4`` — one halo exchange per 4
+generations); shrunk epochs deterministically re-tile via
+``parallel.multihost.global_mesh_for_grid``. ``--mesh band
+--gens-per-exchange 1`` restores the legacy lock-step row-band drill.
+The fault kinds:
 
 - ``process_kill`` — SIGKILL a worker mid-run; every survivor must
   self-detect the dead peer (stale heartbeat / barrier deadline) and
@@ -65,6 +71,14 @@ def build_events(seed: int, workers: int, horizon: int) -> List[FaultEvent]:
     return list(plan.events)
 
 
+def _parse_pair(text: str, what: str) -> tuple:
+    try:
+        a, b = text.lower().split("x")
+        return int(a), int(b)
+    except ValueError:
+        raise SystemExit(f"--{what} wants AxB (e.g. 96x128), got {text!r}")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="chaos drill for the elastic multi-host runtime")
@@ -72,6 +86,15 @@ def main(argv=None) -> int:
     parser.add_argument("--processes", type=int, default=4)
     parser.add_argument("--generations", type=int, default=120)
     parser.add_argument("--chunk", type=int, default=20)
+    parser.add_argument("--shape", default="96x128",
+                        help="grid HxW (default 96x128: 4 packed word "
+                        "columns, enough for a 2x2 mesh of ghost tiles)")
+    parser.add_argument("--mesh", default="2x2",
+                        help="device mesh NXxNY, or 'band' for legacy "
+                        "(n, 1) row bands")
+    parser.add_argument("--gens-per-exchange", type=int, default=4,
+                        help="halo exchange cadence k of the ghost-zone "
+                        "pipeline; 1 = lock-step per-gen exchange")
     parser.add_argument("--chunk-sleep", type=float, default=0.3,
                         help="pacing so faults land mid-run")
     parser.add_argument("--heartbeat-deadline", type=float, default=3.0)
@@ -82,11 +105,16 @@ def main(argv=None) -> int:
     from gameoflifewithactors_tpu.resilience.distributed import (
         EXIT_PREEMPTED, ElasticFleet, ElasticSpec, initial_grid)
 
+    mesh_shape = (None if args.mesh in ("band", "none")
+                  else _parse_pair(args.mesh, "mesh"))
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
     spec = ElasticSpec(
-        shape=(96, 64), target_gens=args.generations, chunk=args.chunk,
+        shape=_parse_pair(args.shape, "shape"),
+        target_gens=args.generations, chunk=args.chunk,
         rng_seed=args.seed,
+        mesh_shape=mesh_shape,
+        gens_per_exchange=args.gens_per_exchange,
         chunk_sleep_seconds=args.chunk_sleep,
         heartbeat_deadline_seconds=args.heartbeat_deadline,
         barrier_deadline_seconds=args.barrier_deadline)
@@ -177,6 +205,17 @@ def main(argv=None) -> int:
           any("CRC32" in why or "unreadable" in why
               for _rec, _d, why in refused),
           f"{len(refused)} refusals")
+
+    # the 2D ghost-zone pipeline really was the compute core: some
+    # epoch's restore records must show the requested mesh with the
+    # ghost runner (shrunk epochs legitimately re-tile to other shapes)
+    if mesh_shape is not None and args.gens_per_exchange > 1:
+        recs = [json.loads(p.read_text())
+                for p in sorted((out / "restore").glob("e*-p*.json"))]
+        check("ghost pipeline ran on the requested 2D mesh",
+              any(r.get("mesh") == list(mesh_shape)
+                  and r.get("runner") == "ghost" for r in recs),
+              f"meshes {sorted({tuple(r.get('mesh', [])) for r in recs})}")
 
     # paper trail: survivors dumped flight tapes; recovery latency landed
     dumps = list((out / "flight").glob("*.jsonl"))

@@ -9,7 +9,11 @@ regression.
 Both artifact shapes are accepted on either side — a RunReport
 (``--telemetry-out``) or a bench record (``bench.py`` stdout /
 ``BENCH_*.json``, whose driver wrapper shape ``{"parsed": {...}}`` is
-unwrapped automatically). Provenance is honored: a record flagged
+unwrapped automatically). Weak-scaling records
+(``scripts/weak_scaling.py --out``) ride the bench shape and add the
+COST headline ``scaling/single_chip_equivalent_updates_per_sec``; a
+record whose single-chip normalizer was stale arrives pre-flagged
+``stale`` and gates as skipped. Provenance is honored: a record flagged
 ``needs_recapture``/``stale`` — or whose commit-stamped measured paths
 changed since capture (utils/provenance.py) — gates as **"skipped
 (stale)"**, never "ok": a stale anchor proves nothing either way.

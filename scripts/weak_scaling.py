@@ -17,6 +17,18 @@ number that matters comes from a real slice).
 
 Timing uses the same scalar-readback sync as bench.py: block_until_ready is
 a no-op on the tunneled-TPU platform.
+
+COST honesty (Frank McSherry's bar): every point also records
+``single_chip_equivalent_updates_per_sec`` — the fleet rate divided by
+the device count, in the SAME units as the single-chip BENCH record —
+plus ``cost_vs_single_chip``, its ratio against the newest BENCH
+single-chip record for this platform. A fleet whose per-chip rate is
+far under the single-chip record is scaling overhead, not capability.
+The normalizer's provenance rides along: a stale reference (no commit
+stamp, or measured paths changed since capture) marks the whole output
+record ``stale``/``needs_recapture``, so ``scripts/perf_gate.py``
+skips it exactly like a stale BENCH record instead of certifying a
+number anchored to a predecessor of HEAD.
 """
 
 from __future__ import annotations
@@ -34,6 +46,46 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)  # allow `python scripts/weak_scaling.py`
 
 
+def _single_chip_reference(platform: str):
+    """The newest BENCH_r*.json single-chip record for this platform —
+    the COST normalizer — as {file, metric, value, commit?, ...,
+    stale, stale_reason?}; None when no round measured this platform.
+    Staleness is re-derived from the record's own commit stamp
+    (utils/provenance.py), so a reference whose measured kernel moved
+    on — or that never carried a stamp — is named stale here and
+    poisons the weak-scaling record the same way (perf_gate skips)."""
+    import glob
+
+    from gameoflifewithactors_tpu.utils import provenance
+
+    ref = None
+    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") if isinstance(rec, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        metric = str(parsed.get("metric", ""))
+        if (not metric.endswith(f"{platform})")
+                or not isinstance(parsed.get("value"), (int, float))):
+            continue
+        ref = {"file": os.path.basename(path), "metric": metric,
+               "value": float(parsed["value"])}
+        for k in ("commit", "commit_dirty", "commit_approx",
+                  "recorded_at", "measured_paths"):
+            if k in parsed:
+                ref[k] = parsed[k]
+    if ref is not None:
+        st = provenance.staleness(ref)
+        ref["stale"] = bool(st.get("stale"))
+        if ref["stale"]:
+            ref["stale_reason"] = st.get("reason", "")
+    return ref
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--tile", default=None, metavar="HxW",
@@ -44,9 +96,12 @@ def main() -> None:
     ap.add_argument("--counts", default=None,
                     help="comma-separated device counts (default: 1,2,4,... up to all)")
     ap.add_argument("--gens-per-exchange", type=int, default=1, metavar="G",
-                    help="G>1 uses the communication-avoiding runner (one "
-                         "depth-G halo exchange per G generations; "
-                         "sharded.make_multi_step_packed_deep)")
+                    help="G>1 runs the width-G ghost-zone pipeline (one "
+                         "halo exchange per G generations, boundary rings "
+                         "first so interior compute overlaps the permutes; "
+                         "sharded.make_multi_step_packed_ghost), falling "
+                         "back to the 1-word deep runner when the tile is "
+                         "too small for 2G-deep ghost zones")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write {summary, series, provenance stamp} as "
                          "one JSON dict — the scoreboard-visible artifact "
@@ -86,6 +141,7 @@ def main() -> None:
     if tw % bitpack.WORD:
         raise SystemExit(f"tile width must be a multiple of {bitpack.WORD}")
     rule = parse_rule(args.rule)
+    single_ref = _single_chip_reference(platform)
 
     if args.counts:
         counts = [int(c) for c in args.counts.split(",")]
@@ -113,6 +169,7 @@ def main() -> None:
         nx, ny = mesh.shape[mesh_lib.ROW_AXIS], mesh.shape[mesh_lib.COL_AXIS]
         H, W = nx * th, ny * tw
         g = args.gens_per_exchange
+        exchange = None  # which bulk-exchange runner served G>1, if any
         if args.runner == "sparse-tiled":
             # one soup blob per device tile (1/64 of its area): per-device
             # activity stays constant across the sweep, so the efficiency
@@ -163,9 +220,15 @@ def main() -> None:
                 s_, act_cell[0] = truns(s_, act_cell[0], n)
                 return s_
         elif g > 1:
-            deep = sharded.make_multi_step_packed_deep(
-                mesh, rule, Topology.TORUS, gens_per_exchange=g)
-            run = lambda s_, n: deep(s_, n // g)
+            if mesh_lib.ghost_fits(th, tw // bitpack.WORD, g):
+                bulk = sharded.make_multi_step_packed_ghost(
+                    mesh, rule, Topology.TORUS, gens_per_exchange=g)
+                exchange = "ghost"
+            else:
+                bulk = sharded.make_multi_step_packed_deep(
+                    mesh, rule, Topology.TORUS, gens_per_exchange=g)
+                exchange = "deep"
+            run = lambda s_, n: bulk(s_, n // g)
             if args.gens % g:
                 raise SystemExit(f"--gens must be a multiple of G={g}")
         else:
@@ -189,9 +252,16 @@ def main() -> None:
             "runner": args.runner,
             "cell_updates_per_sec": best,
             "per_device": best / n,
+            # COST honesty: the fleet rate a single chip's share delivers,
+            # in the single-chip BENCH record's own units
+            "single_chip_equivalent_updates_per_sec": best / n,
             "weak_scaling_efficiency": eff,
             "platform": platform,
         }
+        if exchange is not None:
+            rec["exchange"] = exchange
+        if single_ref is not None and single_ref["value"] > 0:
+            rec["cost_vs_single_chip"] = (best / n) / single_ref["value"]
         if args.runner == "sparse-tiled":
             # the rate above counts every grid cell; most are asleep by
             # design, so record the activity too for honest reading
@@ -207,7 +277,15 @@ def main() -> None:
         "value": results[-1]["weak_scaling_efficiency"],
         "unit": "fraction",
         "devices": results[-1]["devices"],
+        # the COST headline: per-chip rate at the LARGEST device count,
+        # same units as (and gated against) the single-chip BENCH record
+        "single_chip_equivalent_updates_per_sec":
+            results[-1]["single_chip_equivalent_updates_per_sec"],
     }
+    if single_ref is not None and single_ref["value"] > 0:
+        summary["cost_vs_single_chip"] = (
+            summary["single_chip_equivalent_updates_per_sec"]
+            / single_ref["value"])
     print(json.dumps(summary))
     if args.out:
         from gameoflifewithactors_tpu.utils import provenance
@@ -220,9 +298,24 @@ def main() -> None:
         paths += ["gameoflifewithactors_tpu/models/rules.py",
                   "scripts/weak_scaling.py"]
         record = {**summary, "series": results,
+                  "single_chip_reference": single_ref,
                   **provenance.head_stamp(paths=paths),
                   "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                time.gmtime())}
+        if single_ref is None:
+            record["stale"] = True
+            record["needs_recapture"] = True
+            record["stale_reason"] = (
+                f"no single-chip BENCH record for platform {platform!r}; "
+                "cost_vs_single_chip is unanchored")
+        elif single_ref.get("stale"):
+            # BENCH semantics: a stale normalizer poisons the record —
+            # perf_gate must report "skipped (stale)", never "ok"
+            record["stale"] = True
+            record["needs_recapture"] = True
+            record["stale_reason"] = (
+                f"single-chip reference {single_ref['file']} is stale: "
+                f"{single_ref.get('stale_reason', '')}")
         with open(args.out, "w") as f:
             json.dump(record, f, indent=1)
             f.write("\n")

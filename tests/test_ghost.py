@@ -1,0 +1,344 @@
+"""Width-k ghost-zone pipeline (parallel/sharded.make_multi_step_packed_
+ghost): ONE halo exchange per k generations on the 2D device mesh, with
+the exchange issued before interior compute so XLA can overlap them.
+
+The contracts under test:
+
+- **bit-identity** — the pipeline equals the dense single-device oracle
+  for k in {1, 2, 8}, TORUS and DEAD, on 1D-band and 2x2-mesh
+  decompositions (corner traffic rides the two-phase parts exchange);
+- **structural k× reduction** — an unrolled build performs exactly
+  ``chunks`` collective exchanges where the lock-step build (k=1, same
+  pipeline) performs ``k * chunks``, counted from compiled HLO
+  (utils/profiling.collective_permute_count);
+- **byte accounting** — ghost_exchange_bytes (the model the
+  ``halo_bytes_total`` counter records) equals the compiled HLO's
+  collective-permute bytes for one exchange;
+- **guards** — a non-divisible grid is refused at placement and
+  k > tile capacity at trace time, never clamped;
+- **fleet plane** — the halo counters sum across processes while the
+  per-chip overlap gauge refuses summation (obs/aggregate.py), and the
+  2D shard index bounds of sharded checkpoints are validated
+  (utils/checkpoint.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.models.rules import CONWAY
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.packed import multi_step_packed
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+from gameoflifewithactors_tpu.parallel import sharded
+from gameoflifewithactors_tpu.utils.profiling import (
+    collective_permute_bytes,
+    collective_permute_count,
+)
+
+
+def _mesh(shape):
+    return mesh_lib.make_mesh(shape, jax.devices()[: shape[0] * shape[1]])
+
+
+def _soup(shape=(64, 128), seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2, size=shape, dtype=np.uint8)
+
+
+def _place(grid, m):
+    return mesh_lib.device_put_sharded_grid(
+        bitpack.pack(jnp.asarray(grid)), m)
+
+
+class TestBitIdentity:
+    """Pipeline output == dense single-device oracle, bit for bit."""
+
+    @pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD],
+                             ids=lambda t: t.value)
+    @pytest.mark.parametrize("k", [1, 2, 8])
+    @pytest.mark.parametrize("mesh_shape", [(4, 1), (2, 2)],
+                             ids=["band4x1", "mesh2x2"])
+    def test_vs_single_device_oracle(self, mesh_shape, k, topology):
+        grid = _soup()
+        chunks = 2
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            bitpack.pack(jnp.asarray(grid)), chunks * k, rule=CONWAY,
+            topology=topology)))
+        m = _mesh(mesh_shape)
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, topology, gens_per_exchange=k)
+        got = np.asarray(bitpack.unpack(run(_place(grid, m), chunks)))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.slow  # zero-row interior slices: pathological XLA CPU compile
+    def test_boundary_tile_exactly_2k_rows(self):
+        """h == 2k (empty interior slab) is the legal extreme: the tile
+        is all boundary rings, and must still be exact."""
+        m = _mesh((4, 1))  # (64, 128) -> 16-row tiles; k=8 -> 2k == 16
+        grid = _soup()
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            bitpack.pack(jnp.asarray(grid)), 16, rule=CONWAY,
+            topology=Topology.TORUS)))
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=8)
+        got = np.asarray(bitpack.unpack(run(_place(grid, m), 2)))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.slow  # 33-gen block body: ~10 min of XLA CPU compile
+    def test_deep_word_halo_lifts_32_gen_cap(self):
+        """k > 32 needs a 2-word ghost zone per side — the regime the
+        1-word deep runner refuses outright (its g <= 32 cap)."""
+        m = _mesh((2, 1))  # (160, 256) -> (80, 8)-word tiles; k=33, hw=2
+        with pytest.raises(ValueError, match=r"\[1, 32\]"):
+            sharded.make_multi_step_packed_deep(m, CONWAY,
+                                                gens_per_exchange=33)
+        grid = _soup((160, 256))
+        want = np.asarray(bitpack.unpack(multi_step_packed(
+            bitpack.pack(jnp.asarray(grid)), 33, rule=CONWAY,
+            topology=Topology.TORUS)))
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=33)
+        got = np.asarray(bitpack.unpack(run(_place(grid, m), 1)))
+        np.testing.assert_array_equal(got, want)
+
+
+class TestGuards:
+    def test_rejects_k_below_one(self):
+        m = _mesh((2, 2))
+        with pytest.raises(ValueError, match="gens_per_exchange"):
+            sharded.make_multi_step_packed_ghost(m, CONWAY,
+                                                 gens_per_exchange=0)
+
+    def test_refuses_k_exceeding_tile_at_trace_time(self):
+        m = _mesh((4, 1))  # (64, 128) -> 16-row tiles: k=9 needs 18
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=9)
+        with pytest.raises(ValueError, match="needs a per-device tile"):
+            run(_place(_soup(), m), 1)
+
+    def test_refuses_halo_words_exceeding_tile(self):
+        m = _mesh((1, 4))  # (64, 128) -> 1-word tiles: hw=1 needs 2
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=2)
+        with pytest.raises(ValueError, match="needs a per-device tile"):
+            run(_place(_soup(), m), 1)
+
+    def test_refuses_non_divisible_grid_at_placement(self):
+        m = _mesh((4, 1))
+        with pytest.raises(ValueError, match="not divisible"):
+            _place(_soup((30, 128)), m)
+
+    def test_refuses_zero_chunks(self):
+        m = _mesh((2, 2))
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=2)
+        with pytest.raises(ValueError, match="chunks must be >= 1"):
+            run(_place(_soup(), m), 0)
+
+    def test_ghost_fits_and_best_mesh_shape(self):
+        assert mesh_lib.ghost_halo_words(1) == 1
+        assert mesh_lib.ghost_halo_words(32) == 1
+        assert mesh_lib.ghost_halo_words(33) == 2
+        assert mesh_lib.ghost_fits(16, 2, 8)
+        assert not mesh_lib.ghost_fits(15, 2, 8)   # 2k > rows
+        assert not mesh_lib.ghost_fits(64, 1, 8)   # 2hw > words
+        assert not mesh_lib.ghost_fits(64, 4, 0)
+        # (64 rows, 4 words) over 4 devices: 2x2 fits k=8; k=40 needs
+        # 2 words of halo per side so only the (4, 1) band factorization
+        # leaves wide-enough tiles
+        assert mesh_lib.best_mesh_shape(4, 64, 4, gens_per_exchange=8) \
+            == (2, 2)
+        assert mesh_lib.best_mesh_shape(4, 320, 4, gens_per_exchange=40) \
+            == (4, 1)
+        assert mesh_lib.best_mesh_shape(4, 30, 4, gens_per_exchange=8) \
+            is None
+        # gens_per_exchange=0: lock-step divisibility only
+        assert mesh_lib.best_mesh_shape(4, 64, 1, gens_per_exchange=0) \
+            == (4, 1)
+
+
+class TestCollectiveAccounting:
+    """The k× exchange reduction and the byte model, proven from the
+    HLO the compiler actually emits (CPU-runnable: structure, not
+    wall-clock)."""
+
+    def _count(self, run, p):
+        return collective_permute_count(run.lower(p).compile().as_text())
+
+    def test_exchange_count_reduced_exactly_k_times(self):
+        m = _mesh((2, 2))
+        k, chunks = 4, 3
+        grid = _soup()
+        p = _place(grid, m)
+        ghost = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=k,
+            unroll_chunks=chunks)
+        # the lock-step comparator is the SAME pipeline at k=1 (one
+        # exchange per generation) so XLA's collective-combining treats
+        # both builds alike and the instruction ratio is exactly k
+        lock = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=1,
+            unroll_chunks=k * chunks)
+        n_ghost = self._count(ghost, p)
+        n_lock = self._count(lock, p)
+        assert n_ghost > 0
+        assert n_lock == k * n_ghost, (
+            f"expected exactly {k}x fewer exchanges: lock-step emits "
+            f"{n_lock} collective-permutes, ghost emits {n_ghost}")
+
+    def test_modeled_bytes_match_compiled_hlo(self):
+        for mesh_shape, k in [((2, 2), 4), ((4, 1), 8), ((2, 2), 1)]:
+            m = _mesh(mesh_shape)
+            p = _place(_soup(), m)
+            run = sharded.make_multi_step_packed_ghost(
+                m, CONWAY, Topology.TORUS, gens_per_exchange=k,
+                unroll_chunks=1)  # one chunk == exactly one exchange
+            measured = collective_permute_bytes(
+                run.lower(p).compile().as_text())
+            model = sharded.ghost_exchange_bytes(
+                p.shape, m, Topology.TORUS, k)
+            assert measured == model > 0, (
+                f"mesh {mesh_shape}, k={k}: modeled {model} B/exchange "
+                f"!= compiled {measured} B")
+
+    def test_dead_topology_drops_wrap_sends(self):
+        m = _mesh((2, 2))
+        p = _place(_soup(), m)
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.DEAD, gens_per_exchange=4,
+            unroll_chunks=1)
+        measured = collective_permute_bytes(
+            run.lower(p).compile().as_text())
+        model = sharded.ghost_exchange_bytes(p.shape, m, Topology.DEAD, 4)
+        assert measured == model > 0
+        torus = sharded.ghost_exchange_bytes(p.shape, m, Topology.TORUS, 4)
+        assert model < torus  # no wrap traffic on DEAD edges
+
+    def test_halo_counters_land_in_registry(self):
+        from gameoflifewithactors_tpu.obs.registry import REGISTRY
+
+        def value(name):
+            fam = REGISTRY.snapshot().get(name) or {}
+            return sum(s.get("value", 0.0)
+                       for s in fam.get("series", []))
+
+        m = _mesh((2, 2))
+        k, chunks = 2, 3
+        run = sharded.make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=k)
+        ex0, by0 = value("halo_exchanges_total"), value("halo_bytes_total")
+        p = _place(_soup(), m)
+        run(p, chunks)
+        assert value("halo_exchanges_total") - ex0 == chunks
+        per = sharded.ghost_exchange_bytes(
+            (64, 4), m, Topology.TORUS, k)
+        assert value("halo_bytes_total") - by0 == pytest.approx(
+            chunks * per)
+        snap = REGISTRY.snapshot()["halo_overlap_ratio"]
+        ratio = snap["series"][0]["value"]
+        assert 0.0 < ratio < 1.0
+
+
+class TestFleetAggregation:
+    """halo totals sum fleet-wide; the per-chip overlap gauge refuses."""
+
+    def _exposition(self, **series):
+        from gameoflifewithactors_tpu.obs.exporter import render_prometheus
+        from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        for name, v in series.items():
+            if name.endswith("_total"):
+                reg.counter(name, "c").inc(v)
+            else:
+                reg.gauge(name, "g").set(v)
+        return render_prometheus(reg.snapshot())
+
+    def test_halo_totals_sum_overlap_gauge_refuses(self):
+        from gameoflifewithactors_tpu.obs.aggregate import (
+            PerChipSumError, sum_across_procs)
+
+        per_proc = {
+            "w0": self._exposition(halo_exchanges_total=3,
+                                   halo_bytes_total=1024.0,
+                                   halo_overlap_ratio=0.75),
+            "w1": self._exposition(halo_exchanges_total=3,
+                                   halo_bytes_total=2048.0,
+                                   halo_overlap_ratio=0.5),
+        }
+        assert sum_across_procs(per_proc, "halo_exchanges_total") == 6.0
+        assert sum_across_procs(per_proc, "halo_bytes_total") == 3072.0
+        with pytest.raises(PerChipSumError, match="per-chip"):
+            sum_across_procs(per_proc, "halo_overlap_ratio")
+
+
+class TestEngineFacade:
+    def test_engine_routes_to_ghost_pipeline(self):
+        from gameoflifewithactors_tpu import Engine
+
+        m = _mesh((2, 4))
+        grid = _soup((64, 256))
+        ref = Engine(grid, "conway", mesh=m)
+        eng = Engine(grid, "conway", mesh=m, gens_per_exchange=8)
+        assert eng._ghost_pipeline, "tile (32, 2) fits k=8 ghost zones"
+        ref.step(19)
+        eng.step(19)  # 2 ghost chunks + 3 per-gen remainder
+        np.testing.assert_array_equal(eng.snapshot(), ref.snapshot())
+        est = eng.halo_bytes_per_gen(source="model")
+        assert 0 < est < ref.halo_bytes_per_gen(source="model")
+
+    def test_engine_falls_back_to_deep_when_tile_too_small(self):
+        from gameoflifewithactors_tpu import Engine
+
+        m = _mesh((1, 8))  # 1-word tiles: 2hw > words, ghost refused
+        eng = Engine(_soup((64, 256)), "conway", mesh=m,
+                     gens_per_exchange=8)
+        assert not eng._ghost_pipeline
+
+
+class TestShardIndexBounds:
+    """2D-mesh tiles shard BOTH axes of a sharded checkpoint; a
+    re-tiling bug must fail loudly, not clamp (utils/checkpoint.py)."""
+
+    def test_write_refuses_clamped_extent(self, tmp_path):
+        from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+        data = np.zeros((4, 4), np.uint32)
+        # [6, 10) clamps to [6, 8): 2 columns of data claimed as 4
+        with pytest.raises(ckpt.CheckpointCorruptError, match="covers"):
+            ckpt.write_shards(
+                tmp_path, 0, [((slice(0, 4), slice(6, 10)), data)],
+                global_shape=(4, 8), dtype=np.uint32)
+
+    def test_write_refuses_rank_mismatch(self, tmp_path):
+        from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+        data = np.zeros((4, 4), np.uint32)
+        with pytest.raises(ckpt.CheckpointCorruptError, match="rank"):
+            ckpt.write_shards(
+                tmp_path, 0, [((slice(0, 4),), data)],
+                global_shape=(4, 8), dtype=np.uint32)
+
+    def test_verify_catches_out_of_bounds_manifest_index(self, tmp_path):
+        import json
+
+        from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+        shards = [((slice(0, 4), slice(0, 4)),
+                   np.arange(16, dtype=np.uint32).reshape(4, 4)),
+                  ((slice(0, 4), slice(4, 8)),
+                   np.arange(16, 32, dtype=np.uint32).reshape(4, 4))]
+        ckpt.write_shards(tmp_path, 0, shards,
+                          global_shape=(4, 8), dtype=np.uint32)
+        ckpt.commit_manifest(tmp_path, meta={}, num_processes=1)
+        ckpt.verify_sharded(tmp_path)  # sane manifest passes
+        mpath = tmp_path / "MANIFEST.json"
+        manifest = json.loads(mpath.read_text())
+        manifest["processes"][0]["shards"][1]["index"] = [[0, 4], [6, 10]]
+        mpath.write_text(json.dumps(manifest))
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="out of bounds"):
+            ckpt.verify_sharded(tmp_path)
