@@ -89,6 +89,13 @@ def _parse(argv):
                          "gameoflifewithactors_tpu report PATH`. Written by "
                          "the measuring child, so a fresh measurement is "
                          "required (a persisted-record fallback writes none)")
+    ap.add_argument("--profile-sample", type=float, default=None, metavar="S",
+                    help="arm the sampling profiler in the measuring child "
+                         "(one short jax.profiler window every S seconds): "
+                         "op-class attribution lands in the RunReport's "
+                         "profile section and a sibling .attribution.json "
+                         "the persisted record points at. Off by default; "
+                         "also honored via $GOLTPU_PROFILE_SAMPLE_S")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -97,6 +104,15 @@ def _config_key(args) -> str:
     """Persistence key from the *requested* config (None size stays 'default'
     so a driver run with no args matches an earlier healthy-tunnel run)."""
     return f"{args.backend}:{args.size or 'default'}:{args.rule}"
+
+
+def _attribution_path(report_path: str) -> str:
+    """Sibling attribution JSON of a RunReport (the jax-free mirror of
+    obs.profiler.attribution_path_for — the parent must not import the
+    package)."""
+    stem = (report_path[: -len(".json")]
+            if report_path.endswith(".json") else report_path)
+    return stem + ".attribution.json"
 
 
 def _default_report_path(key: str) -> str:
@@ -169,8 +185,15 @@ def _persist_if_best(key: str, result: dict,
         if report_path and os.path.exists(report_path):
             # pointer to the measurement's RunReport (repo-relative so a
             # fresh checkout resolves it)
+            repo_root = os.path.dirname(os.path.dirname(PERSIST_PATH))
             store[key]["telemetry_report"] = os.path.relpath(
-                report_path, os.path.dirname(os.path.dirname(PERSIST_PATH)))
+                report_path, repo_root)
+            apath = _attribution_path(report_path)
+            if os.path.exists(apath):
+                # profiler-armed measurement: the op-class attribution
+                # summary rides next to the report (ISSUE 18)
+                store[key]["profile_attribution"] = os.path.relpath(
+                    apath, repo_root)
         os.makedirs(os.path.dirname(PERSIST_PATH), exist_ok=True)
         tmp = PERSIST_PATH + ".tmp"
         with open(tmp, "w") as f:
@@ -287,8 +310,12 @@ def run_bench(args) -> None:
         # an in-process stall event (naming the last-completed span)
         # escapes on stderr BEFORE the parent's subprocess watchdog kills
         # a wedged child — the diagnostics the wedged-probe runs never had
+        profile_sample = args.profile_sample
+        if profile_sample is None and os.environ.get("GOLTPU_PROFILE_SAMPLE_S"):
+            profile_sample = float(os.environ["GOLTPU_PROFILE_SAMPLE_S"])
         telem = begin_run_telemetry(stall_deadline=float(
-            os.environ.get("BENCH_STALL_DEADLINE_S", "60")))
+            os.environ.get("BENCH_STALL_DEADLINE_S", "60")),
+            profile_sample=profile_sample)
 
     def _span(name, **attrs):
         if telem is None:
@@ -541,6 +568,12 @@ def run_bench(args) -> None:
         run_report.save(args.telemetry_out)
         sys.stderr.write(
             f"telemetry report written: {args.telemetry_out}\n")
+        if run_report.profile is not None:
+            apath = _attribution_path(args.telemetry_out)
+            with open(apath, "w") as f:
+                json.dump(run_report.profile, f, indent=1)
+                f.write("\n")
+            sys.stderr.write(f"profile attribution written: {apath}\n")
 
 
 def main() -> None:
@@ -577,6 +610,11 @@ def main() -> None:
         # explicit --telemetry-out is the caller's own business)
         if report_defaulted and os.path.exists(report_path):
             os.replace(report_path, report_path[:-5] + ".cpu.json")
+        # the attribution summary follows its report into quarantine —
+        # CPU host-track attribution must not pose as the TPU record's
+        apath = _attribution_path(report_path)
+        if report_defaulted and os.path.exists(apath):
+            os.replace(apath, apath[:-5] + ".cpu.json")
 
     tpu_ok = True
     if not args.no_probe:

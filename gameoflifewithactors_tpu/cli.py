@@ -47,7 +47,8 @@ def _run_elementary(cfg, args, rule) -> int:
                         ("--telemetry-out", cfg.telemetry_out),
                         ("--serve-metrics", cfg.serve_metrics),
                         ("--flight-dump", cfg.flight_dump),
-                        ("--device-poll", cfg.device_poll)):
+                        ("--device-poll", cfg.device_poll),
+                        ("--profile-sample", cfg.profile_sample)):
         if value is not None:
             raise SystemExit(
                 f"{flag} is not supported for 1D W-rules (the spacetime "
@@ -284,6 +285,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # next to the RunReport), or standalone via --flight-dump
     flight_path = cfg.flight_dump or (
         cfg.telemetry_out + ".flight.jsonl" if cfg.telemetry_out else None)
+    # sampling profiler (obs/profiler.py): off by default, armed by
+    # --profile-sample or $GOLTPU_PROFILE_SAMPLE_S
+    profile_sample = cfg.profile_sample
+    if profile_sample is None and os.environ.get("GOLTPU_PROFILE_SAMPLE_S"):
+        profile_sample = float(os.environ["GOLTPU_PROFILE_SAMPLE_S"])
+    standalone_profiler = None
     telem = None
     if cfg.telemetry_out:
         from .obs import begin_run_telemetry
@@ -293,9 +300,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # not watch interactive seed parsing either — run time only
         telem = begin_run_telemetry(
             stall_deadline=cfg.stall_deadline or 60.0,
-            flight_path=flight_path)
+            flight_path=flight_path,
+            profile_sample=profile_sample)
         telem.attach(coordinator)
-    elif flight_path:
+    elif profile_sample:
+        # no report to fold into, but the profile_* gauges still feed
+        # --serve-metrics scrapes
+        from .obs import profiler as profiler_lib
+
+        standalone_profiler = profiler_lib.arm(
+            profiler_lib.ProfileSampler(profile_sample))
+    if telem is None and flight_path:
         from .obs import flight as flight_lib
 
         fr = flight_lib.arm(flight_lib.FlightRecorder(flight_path))
@@ -400,10 +415,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.save(cfg.telemetry_out)
         print(f"telemetry report written: {cfg.telemetry_out}",
               file=sys.stderr)
-    elif flight_path:
-        from .obs import flight as flight_lib
+        if report.profile is not None:
+            # the standalone attribution artifact (CI uploads it; bench
+            # records point at its sibling) — same content as the
+            # report's profile section, greppable without the report
+            import json as _json
 
-        flight_lib.disarm()  # clean exit: no crash report to leave
+            from .obs.profiler import attribution_path_for
+
+            apath = attribution_path_for(cfg.telemetry_out)
+            with open(apath, "w") as f:
+                _json.dump(report.profile, f, indent=1)
+                f.write("\n")
+            print(f"profile attribution written: {apath}", file=sys.stderr)
+    else:
+        if standalone_profiler is not None:
+            from .obs import profiler as profiler_lib
+
+            if standalone_profiler is profiler_lib.active_sampler():
+                profiler_lib.disarm()
+            else:
+                standalone_profiler.stop()
+        if flight_path:
+            from .obs import flight as flight_lib
+
+            flight_lib.disarm()  # clean exit: no crash report to leave
 
     if sampler is not None:
         sampler.stop()

@@ -55,6 +55,7 @@ class SimulationConfig:
     flight_dump: Optional[str] = None       # flight-recorder dump path;
     #                                         default <telemetry_out>.flight.jsonl
     device_poll: Optional[float] = None     # device-sampler interval seconds
+    profile_sample: Optional[float] = None  # sampling-profiler period seconds
     cache_dir: Optional[str] = None         # warm-start cache root (aot/);
     #                                         None = GOLTPU_CACHE_DIR env or
     #                                         ~/.cache/gameoflifewithactors_tpu
@@ -274,6 +275,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="device memory sampler interval in seconds "
                         "(default 1.0, or $GOLTPU_DEVICE_POLL_S); feeds "
                         "the hbm_bytes_* gauges --serve-metrics exposes")
+    p.add_argument("--profile-sample", type=float, default=None, metavar="S",
+                   help="arm the always-on sampling profiler: one 200 ms "
+                        "jax.profiler window every S seconds, op-class "
+                        "attribution into the RunReport profile section + "
+                        "profile_* gauges (off by default; also honored "
+                        "via $GOLTPU_PROFILE_SAMPLE_S; the window is "
+                        "capped at 10%% of S)")
     p.add_argument("--stall-deadline", type=float, default=None, metavar="S",
                    help="with --telemetry-out: flag any tick exceeding S "
                         "seconds, naming the last-completed span "
@@ -324,6 +332,7 @@ def from_args(argv=None) -> "tuple[SimulationConfig, argparse.Namespace]":
         serve_metrics=args.serve_metrics,
         flight_dump=args.flight_dump,
         device_poll=args.device_poll,
+        profile_sample=args.profile_sample,
         cache_dir=args.cache_dir,
     )
     return cfg, args

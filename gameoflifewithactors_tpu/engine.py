@@ -27,6 +27,7 @@ from .analysis import sanitizers as _sanitizers
 from .models.generations import GenRule, parse_any
 from .models.ltl import LtLRule
 from .models.rules import Rule
+from .obs import profiler as obs_profiler
 from .obs import spans as obs_spans
 from .ops import bitpack
 from .ops.packed import multi_step_packed
@@ -762,8 +763,14 @@ class Engine:
         # span = dispatch time only (async backends return before the device
         # finishes); the sync cost shows under engine.sync, readback under
         # engine.snapshot — the separation the telemetry report keys on
+        # the profiler annotation is a nullcontext unless a sampling
+        # profiler is armed (obs/profiler.py): armed capture windows
+        # show "goltpu.dispatch[...]" slices on the host track, unarmed
+        # runs pay nothing
         with obs_spans.span("engine.step", generations=n,
-                            backend=self.backend):
+                            backend=self.backend), \
+                obs_profiler.dispatch_annotation(
+                    f"goltpu.dispatch[{self.backend}]"):
             if self._sparse is not None:
                 # the sparse backend's one-scalar-per-step readback is
                 # its documented contract (copy-free overflow design) —

@@ -78,6 +78,13 @@ from .compile import (  # noqa: F401
 from .watchdog import StallEvent, StallWatchdog, active_watchdog, arm, disarm  # noqa: F401
 from .report import RunReport, RunTelemetry, begin_run_telemetry  # noqa: F401
 from .device import DeviceSampler, roofline_section  # noqa: F401
+from .profiler import (  # noqa: F401
+    OP_CLASSES,
+    ProfileSampler,
+    active_sampler,
+    classify_slice,
+    dispatch_annotation,
+)
 from .exporter import MetricsServer, render_prometheus, serve_metrics  # noqa: F401
 from .flight import FlightRecorder, active_flight_recorder, load_dump  # noqa: F401
 from .diff import diff_records, format_rows, gate  # noqa: F401
@@ -92,6 +99,8 @@ __all__ = [
     "StallEvent", "StallWatchdog", "active_watchdog", "arm", "disarm",
     "RunReport", "RunTelemetry", "begin_run_telemetry",
     "DeviceSampler", "roofline_section",
+    "OP_CLASSES", "ProfileSampler", "active_sampler", "classify_slice",
+    "dispatch_annotation",
     "MetricsServer", "render_prometheus", "serve_metrics",
     "FlightRecorder", "active_flight_recorder", "load_dump",
     "diff_records", "format_rows", "gate",

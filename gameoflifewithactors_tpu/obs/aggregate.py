@@ -51,6 +51,15 @@ PER_CHIP_GAUGES = frozenset({
     # fleet "sum of ratios" is meaningless. The halo *totals*
     # (halo_bytes_total, halo_exchanges_total) are counters and sum.
     "halo_overlap_ratio",
+    # same discipline for the sampling profiler's measured figures
+    # (ISSUE 18): one chip's overlap ratio, attribution share, duty
+    # cycle and overhead are per-chip ratios. The attributed
+    # device-second *totals* (profile_op_class_seconds_total) are
+    # counters and sum.
+    "halo_overlap_ratio_measured",
+    "profile_op_class_fraction",
+    "profile_duty_cycle",
+    "profile_overhead_ratio",
 })
 
 

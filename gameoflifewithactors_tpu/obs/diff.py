@@ -122,6 +122,82 @@ def extract_metrics(record: dict) -> Dict[str, dict]:
     return out
 
 
+# op-class deltas within this band read as "flat" in the blame section
+BLAME_FLAT_PCT = 0.02
+
+
+def extract_attribution(record: dict) -> Optional[dict]:
+    """Per-window op-class seconds from a RunReport's ``profile``
+    section (ISSUE 18), or None when the record carries none (sampler
+    unarmed, bench record, pre-profiler schema). Normalizing by window
+    count makes two runs with different durations comparable."""
+    if not isinstance(record, dict):
+        return None
+    prof = record.get("profile")
+    if not isinstance(prof, dict):
+        return None
+    op = prof.get("op_class_seconds")
+    if not isinstance(op, dict) or not op:
+        return None
+    windows = prof.get("windows") or 1
+    per_window = {cls: float(v) / windows for cls, v in op.items()
+                  if isinstance(v, (int, float))}
+    if not any(per_window.values()):
+        return None
+    return {"source": prof.get("source"), "windows": windows,
+            "per_window_s": per_window}
+
+
+def attribution_blame(baseline: dict, current: dict) -> List[dict]:
+    """Rank op classes by their contribution to the busy-time delta —
+    the "why" behind a step-time regression: "collective-permute +31%,
+    stencil flat" instead of a bare fail. Empty when either side lacks
+    attribution (the gate's exit-code contract never depends on it)."""
+    b = extract_attribution(baseline)
+    c = extract_attribution(current)
+    if not b or not c:
+        return []
+    classes = sorted(set(b["per_window_s"]) | set(c["per_window_s"]))
+    rows = []
+    for cls in classes:
+        bv = b["per_window_s"].get(cls, 0.0)
+        cv = c["per_window_s"].get(cls, 0.0)
+        delta = cv - bv
+        if bv > 0:
+            pct: Optional[float] = delta / bv
+        else:
+            pct = None if cv > 0 else 0.0  # None = class appeared fresh
+        rows.append({"op_class": cls,
+                     "baseline_s_per_window": bv,
+                     "current_s_per_window": cv,
+                     "delta_s_per_window": delta,
+                     "delta_pct": pct})
+    rows.sort(key=lambda r: (-abs(r["delta_s_per_window"]), r["op_class"]))
+    return rows
+
+
+def format_blame(rows: List[dict]) -> List[str]:
+    """The human blame section (perf_gate stdout under a regression)."""
+    if not rows:
+        return []
+    width = max(len(r["op_class"]) for r in rows)
+    lines = ["attribution blame (op-class busy s/window, "
+             "largest contribution delta first):"]
+    for r in rows:
+        pct = r["delta_pct"]
+        if pct is None:
+            label = "new"
+        elif abs(pct) <= BLAME_FLAT_PCT:
+            label = "flat"
+        else:
+            label = f"{pct:+.0%}"
+        lines.append(
+            f"  {r['op_class']:{width}}  {label:>6}  "
+            f"({r['baseline_s_per_window']:.4g}s -> "
+            f"{r['current_s_per_window']:.4g}s)")
+    return lines
+
+
 @dataclasses.dataclass
 class DiffRow:
     metric: str
@@ -219,11 +295,17 @@ def gate(baseline: dict, current: dict, *,
                 "reason": "no comparable metrics between the two records",
                 "rows": rows}
     bad = [r for r in rows if r.status == "regression"]
-    return {"status": "regression" if bad else "ok",
-            "reason": (f"{len(bad)} metric(s) regressed beyond tolerance"
-                       if bad else
-                       f"{len(comparable)} metric(s) within tolerance"),
-            "rows": rows}
+    verdict = {"status": "regression" if bad else "ok",
+               "reason": (f"{len(bad)} metric(s) regressed beyond tolerance"
+                          if bad else
+                          f"{len(comparable)} metric(s) within tolerance"),
+               "rows": rows}
+    blame = attribution_blame(baseline, current)
+    if blame:
+        # advisory only: blame explains a verdict, it never changes one
+        # (the 0/1/2 exit contract is pinned by tests/test_perf_gate.py)
+        verdict["blame"] = blame
+    return verdict
 
 
 def format_rows(rows: List[DiffRow]) -> List[str]:

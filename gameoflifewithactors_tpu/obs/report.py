@@ -44,12 +44,18 @@ class RunReport:
     metrics: dict                      # registry snapshot
     perfetto: Optional[dict] = None    # device duty cycle, when a trace exists
     roofline: Optional[dict] = None    # obs.device.roofline_section output
+    profile: Optional[dict] = None     # ProfileSampler.attribution(), armed runs
     schema_version: int = SCHEMA_VERSION
 
     # -- serialization -------------------------------------------------------
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if d.get("profile") is None:
+            # sampler off (the default): the serialized report stays
+            # byte-compatible with pre-profiler reports
+            del d["profile"]
+        return d
 
     def to_json(self, indent: int = 1) -> str:
         return json.dumps(self.to_dict(), indent=indent)
@@ -136,6 +142,29 @@ class RunReport:
                 lines.append(
                     f"device duty cycle: {busy / span:.1%} "
                     f"({self.perfetto.get('device_track')})")
+        if self.profile:
+            p = self.profile
+            lines.append(
+                f"sampling profiler: {p.get('windows', 0)} window(s), "
+                f"source={p.get('source')}, "
+                f"duty {p.get('duty_cycle', 0.0):.2%}")
+            frac = p.get("op_class_fraction") or {}
+            shares = sorted(((k, v) for k, v in frac.items() if v),
+                            key=lambda kv: -kv[1])
+            if shares:
+                lines.append("  op classes: " + ", ".join(
+                    f"{k} {v:.0%}" for k, v in shares))
+            meas = p.get("halo_overlap_ratio_measured")
+            static = p.get("halo_overlap_ratio_static")
+            if meas is not None:
+                line = f"  halo overlap measured {meas:.1%}"
+                if static is not None:
+                    line += f" vs static {static:.1%}"
+                lines.append(line)
+            elif static is not None:
+                lines.append(
+                    f"  halo overlap static {static:.1%} "
+                    f"(measured: n/a — {p.get('source')} capture)")
         return lines
 
 
@@ -164,6 +193,7 @@ def build_run_report(
     config: Optional[dict] = None,
     halo_bytes: Optional[dict] = None,
     roofline: Optional[dict] = None,
+    profile: Optional[dict] = None,
 ) -> RunReport:
     """Assemble a RunReport from whichever pillars the run exercised.
 
@@ -233,6 +263,7 @@ def build_run_report(
         metrics=REGISTRY.snapshot(),
         perfetto=perfetto,
         roofline=roofline,
+        profile=profile,
     )
 
 
@@ -244,11 +275,14 @@ class RunTelemetry:
     signal / coordinator-loop exception) for the session, and
     ``device_poll`` starts a :class:`~.device.DeviceSampler` feeding HBM
     gauges into the registry on that interval — both torn down by
-    :meth:`finish`."""
+    :meth:`finish`. ``profile_sample`` (ISSUE 18) arms a duty-cycled
+    :class:`~.profiler.ProfileSampler` on that period; its cumulative
+    op-class attribution lands in the report's ``profile`` section."""
 
     def __init__(self, *, stall_deadline: Optional[float] = None,
                  flight_path: Optional[str] = None,
-                 device_poll: Optional[float] = None):
+                 device_poll: Optional[float] = None,
+                 profile_sample: Optional[float] = None):
         from ..utils.metrics import BufferSink
 
         spans_lib.TRACER.clear()
@@ -270,6 +304,12 @@ class RunTelemetry:
             from .device import DeviceSampler
 
             self.sampler = DeviceSampler(device_poll).start()
+        self.profiler = None
+        if profile_sample:
+            from . import profiler as profiler_lib
+
+            self.profiler = profiler_lib.arm(
+                profiler_lib.ProfileSampler(profile_sample))
 
     def attach(self, coordinator) -> None:
         """Hang the StepMetrics buffer on a coordinator (creating its
@@ -297,6 +337,15 @@ class RunTelemetry:
         if engine is not None:
             engine.block_until_ready()
             engine.snapshot(max_shape=(8, 8))
+        profile = None
+        if self.profiler is not None:
+            from . import profiler as profiler_lib
+
+            if self.profiler is profiler_lib.active_sampler():
+                profiler_lib.disarm()
+            else:
+                self.profiler.stop()
+            profile = self.profiler.attribution()
         if self.sampler is not None:
             self.sampler.sample_once()  # final gauges reflect end-of-run
             self.sampler.stop()
@@ -313,14 +362,16 @@ class RunTelemetry:
         return build_run_report(
             step_records=self.step_buffer.records, engine=engine,
             watchdog=self.watchdog, trace_path=trace_path, config=config,
-            halo_bytes=halo_bytes)
+            halo_bytes=halo_bytes, profile=profile)
 
 
 def begin_run_telemetry(*, stall_deadline: Optional[float] = None,
                         flight_path: Optional[str] = None,
-                        device_poll: Optional[float] = None
+                        device_poll: Optional[float] = None,
+                        profile_sample: Optional[float] = None
                         ) -> RunTelemetry:
     """Start a fresh telemetry session (clears the global tracer and
     compile log — earlier runs' spans must not leak into this report)."""
     return RunTelemetry(stall_deadline=stall_deadline,
-                        flight_path=flight_path, device_poll=device_poll)
+                        flight_path=flight_path, device_poll=device_poll,
+                        profile_sample=profile_sample)

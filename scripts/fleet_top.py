@@ -8,7 +8,9 @@ Polls every target through a :class:`FleetAggregator` (TTL-cached, so
 pointing several fleet_tops at the same fleet does not multiply scrape
 load) and renders one row per worker: lanes and slot occupancy,
 sessions and distinct tenants, per-proc steps/sec, HBM in use against
-the limit, heartbeat misses, and retraces (post-warm jit compiles).
+the limit, heartbeat misses, retraces (post-warm jit compiles), and the
+sampling profiler's duty cycle + measured capture overhead (PROF /
+PROF-OH, "-" when unarmed).
 
 Rates and HBM are per-chip numbers: each row reads one process's
 gauges, and nothing here sums them across rows (the aggregator refuses
@@ -35,7 +37,7 @@ from gameoflifewithactors_tpu.obs.aggregate import (  # noqa: E402
     AggregatorServer, FleetAggregator, base_name)
 
 COLUMNS = ("PROC", "UP", "LANES", "SLOTS", "SESS", "TENANTS", "STEPS/S",
-           "HBM", "HB-MISS", "RETRACE", "STALLS")
+           "HBM", "HB-MISS", "RETRACE", "STALLS", "PROF", "PROF-OH")
 
 
 def _samples(parsed: Optional[dict], family: str) -> List[tuple]:
@@ -47,6 +49,12 @@ def _samples(parsed: Optional[dict], family: str) -> List[tuple]:
 
 def _total(parsed: Optional[dict], family: str) -> float:
     return sum(v for _l, v in _samples(parsed, family))
+
+
+def _ratio(parsed: Optional[dict], family: str) -> str:
+    """A per-chip ratio gauge as a percentage, '-' when unarmed."""
+    vals = [v for _l, v in _samples(parsed, family)]
+    return f"{max(vals):.1%}" if vals else "-"
 
 
 def _fmt_bytes(n: float) -> str:
@@ -85,6 +93,11 @@ def row_for(proc: str, parsed: Optional[dict]) -> List[str]:
         f"{_total(parsed, 'elastic_heartbeat_misses_total'):.0f}",
         f"{_total(parsed, 'jit_compiles'):.0f}",
         f"{_total(parsed, 'stalls'):.0f}",
+        # sampling-profiler visibility (ISSUE 18): an armed fleet is
+        # visibly armed — configured duty cycle and measured capture
+        # overhead, both per-chip ratios (max, never summed)
+        _ratio(parsed, "profile_duty_cycle"),
+        _ratio(parsed, "profile_overhead_ratio"),
     ]
 
 

@@ -24,6 +24,12 @@ Usage:
   python scripts/perf_gate.py base_report.json run.json --tolerance 0.4 \\
       --tol phase/=1.0 --tol step/best_cell_updates_per_sec=0.2
 
+When both records carry a sampling-profiler ``profile`` section
+(``--profile-sample`` runs, ISSUE 18), a regression verdict adds an
+**attribution blame** section ranking op classes by busy-time
+contribution delta ("collective_permute +31%, stencil flat") — advisory
+output only; the exit-code contract below is unchanged.
+
 Exit codes: 0 = ok or skipped(stale), 1 = regression, 2 = unusable input.
 ``--informational`` always exits 0 (CI's warm-up mode — report, don't
 block) but still prints the real verdict. Stdlib only; loads the differ
@@ -124,10 +130,16 @@ def main(argv=None) -> int:
             "reason": verdict["reason"],
             "baseline": args.baseline, "current": args.current,
             "rows": [r.to_dict() for r in verdict["rows"]],
+            "blame": verdict.get("blame", []),
         }, indent=1))
     else:
         if verdict["rows"]:
             print("\n".join(diff_lib.format_rows(verdict["rows"])))
+        # the attribution blame section (ISSUE 18): *why* it regressed,
+        # ranked by op-class contribution delta. Advisory — the
+        # 0/1/2 exit contract below is unchanged.
+        if verdict.get("blame") and status == "regression":
+            print("\n".join(diff_lib.format_blame(verdict["blame"])))
         print(f"perf gate: {label} — {verdict['reason']}")
     if status == "regression" and not args.informational:
         return 1

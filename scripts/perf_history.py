@@ -1,0 +1,323 @@
+"""Perf history: fold loose bench artifacts into one queryable curve.
+
+The repo's performance record is scattered — ``BENCH_r*.json`` driver
+wrappers at the root, bench records / weak-scaling records / RunReports
+under ``results/`` — and only a human rereading files sees the
+trajectory. This script folds them all into an **append-only**
+``results/history.jsonl`` time-series (one JSON object per line, deduped
+by content fingerprint so re-running never duplicates), then prints a
+markdown trend table per metric series with **median/MAD anomaly
+detection**: an entry more than 3.5 robust standard deviations from its
+series median is flagged (with a 30%-of-median fallback when the MAD
+collapses to zero — a series of identical values plus one outlier).
+
+Shapes folded (the same ones scripts/perf_gate.py accepts):
+- ``BENCH_r*.json``: driver wrappers, measurement under ``"parsed"``;
+- ``results/*.json`` bench records (``{"metric", "value", ...}``),
+  including weak-scaling records;
+- ``results/tpu_best.json`` / ``tpu_worklist.json`` stores (one entry
+  per persisted key);
+- RunReports (``step_metrics``): best cell-updates/sec per report file.
+
+Usage:
+  python scripts/perf_history.py                    # fold + append + trend
+  python scripts/perf_history.py --check            # read-only anomaly scan
+  python scripts/perf_history.py --check --strict   # exit 1 on anomaly
+  python scripts/perf_history.py --markdown TREND.md
+
+Exit codes: 0 = ok (``--check`` without ``--strict`` is informational —
+anomalies print but never block, CI's warm-up mode), 1 = ``--strict``
+and anomalies found, 2 = unusable input. Stdlib only, no jax, no
+package import — history must be writable while a TPU tunnel is wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: |robust z| above this flags an anomaly (0.6745 * (x - median) / MAD).
+ANOMALY_Z = 3.5
+#: MAD == 0 fallback: relative deviation from the median above this flags.
+ANOMALY_REL = 0.30
+#: A series needs at least this many entries before anomalies are called
+#: (a 2-point series has no notion of "typical").
+MIN_SERIES = 4
+
+
+# -- entry extraction ---------------------------------------------------------
+
+
+def _entry(series: str, value, unit=None, recorded_at=None, commit=None,
+           stale=None, source: str = "?") -> Optional[dict]:
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return None
+    e = {"series": series, "value": float(value), "source": source}
+    if unit:
+        e["unit"] = unit
+    if recorded_at:
+        e["recorded_at"] = recorded_at
+    if commit:
+        e["commit"] = commit
+    if stale:
+        e["stale"] = True
+    e["id"] = hashlib.sha1(
+        f"{series}|{e['value']!r}|{recorded_at or ''}|{source}"
+        .encode()).hexdigest()[:16]
+    return e
+
+
+def _from_bench_record(rec: dict, source: str) -> List[dict]:
+    out = []
+    e = _entry(rec["metric"], rec.get("value"), rec.get("unit"),
+               rec.get("recorded_at"), rec.get("commit"),
+               rec.get("stale") or rec.get("needs_recapture"), source)
+    if e:
+        out.append(e)
+    sceq = rec.get("single_chip_equivalent_updates_per_sec")
+    e = _entry(f"{rec['metric']} [per-chip-equivalent]", sceq,
+               rec.get("unit"), rec.get("recorded_at"), rec.get("commit"),
+               rec.get("stale"), source)
+    if e:
+        out.append(e)
+    return out
+
+
+def _from_run_report(rec: dict, source: str) -> List[dict]:
+    rates = [m.get("cell_updates_per_sec")
+             for m in rec.get("step_metrics") or []
+             if isinstance(m, dict)
+             and isinstance(m.get("cell_updates_per_sec"), (int, float))]
+    if not rates:
+        return []
+    stem = os.path.splitext(os.path.basename(source))[0]
+    e = _entry(f"report/{stem}/best_cell_updates_per_sec", max(rates),
+               "cell-updates/sec", rec.get("created_at"), None, None, source)
+    return [e] if e else []
+
+
+def extract_entries(rec, source: str) -> List[dict]:
+    """History entries from one loaded JSON artifact (any known shape);
+    [] for shapes with nothing to track (manifests, logs)."""
+    if not isinstance(rec, dict):
+        return []
+    if isinstance(rec.get("parsed"), dict) and "metric" not in rec:
+        rec = rec["parsed"]        # BENCH_rNN driver wrapper
+    if "metric" in rec and "value" in rec:
+        return _from_bench_record(rec, source)
+    if isinstance(rec.get("step_metrics"), list):
+        return _from_run_report(rec, source)
+    # a store (tpu_best.json / tpu_worklist.json): key -> record
+    out: List[dict] = []
+    for key, sub in rec.items():
+        if isinstance(sub, dict) and "metric" in sub and "value" in sub:
+            out.extend(_from_bench_record(sub, f"{source}#{key}"))
+    return out
+
+
+def scan_repo(repo: str) -> List[dict]:
+    """All history entries extractable from the repo's committed perf
+    artifacts (BENCH_*.json + results/*.json), unreadable files skipped
+    with a stderr note — one bad artifact must not hide the rest."""
+    entries: List[dict] = []
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_*.json")))
+    paths += sorted(glob.glob(os.path.join(repo, "results", "*.json")))
+    for path in paths:
+        rel = os.path.relpath(path, repo)
+        if rel.endswith("history.jsonl"):
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"perf_history: skipping {rel}: {exc}", file=sys.stderr)
+            continue
+        entries.extend(extract_entries(rec, rel))
+    return entries
+
+
+# -- the append-only history file ---------------------------------------------
+
+
+def load_history(path: str) -> List[dict]:
+    entries = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # a torn tail line must not kill the scan
+                if isinstance(rec, dict) and "series" in rec:
+                    entries.append(rec)
+    except OSError:
+        pass
+    return entries
+
+
+def fold(repo: str, history_path: str, *, write: bool = True) -> dict:
+    """Merge fresh repo entries into the history. Append-only: existing
+    lines are never rewritten; new entries (by fingerprint) are appended
+    with an ``appended_at`` stamp. Returns {"history", "appended"}."""
+    history = load_history(history_path)
+    seen = {e.get("id") for e in history}
+    fresh = [e for e in scan_repo(repo) if e["id"] not in seen]
+    # dedupe within the scan too (tpu_best and a BENCH wrapper can carry
+    # the identical measurement)
+    uniq: Dict[str, dict] = {}
+    for e in fresh:
+        uniq.setdefault(e["id"], e)
+    fresh = list(uniq.values())
+    if fresh:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for e in fresh:
+            e["appended_at"] = stamp
+        if write:
+            os.makedirs(os.path.dirname(history_path) or ".", exist_ok=True)
+            with open(history_path, "a") as f:
+                for e in fresh:
+                    f.write(json.dumps(e, sort_keys=True) + "\n")
+    return {"history": history + fresh, "appended": fresh}
+
+
+# -- median/MAD anomaly detection ---------------------------------------------
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def series_stats(entries: List[dict]) -> Dict[str, dict]:
+    """Per-series robust stats + anomaly flags, entries in recorded
+    order (recorded_at when present, else file order)."""
+    by_series: Dict[str, List[dict]] = {}
+    for e in entries:
+        by_series.setdefault(e["series"], []).append(e)
+    out: Dict[str, dict] = {}
+    for series, es in by_series.items():
+        es = sorted(es, key=lambda e: e.get("recorded_at") or "")
+        values = [e["value"] for e in es]
+        med = _median(values)
+        mad = _median([abs(v - med) for v in values])
+        anomalies = []
+        if len(values) >= MIN_SERIES:
+            for e in es:
+                dev = abs(e["value"] - med)
+                if mad > 0:
+                    z = 0.6745 * dev / mad
+                    if z > ANOMALY_Z:
+                        anomalies.append({**e, "robust_z": round(z, 2)})
+                elif med != 0 and dev / abs(med) > ANOMALY_REL:
+                    anomalies.append({**e, "rel_dev": round(dev / abs(med), 3)})
+        out[series] = {
+            "count": len(values),
+            "min": min(values), "median": med, "max": max(values),
+            "mad": mad,
+            "latest": values[-1],
+            "latest_vs_median": (values[-1] / med) if med else None,
+            "anomalies": anomalies,
+        }
+    return out
+
+
+def trend_table(stats: Dict[str, dict]) -> List[str]:
+    """The markdown trend table — the queryable face of the curve."""
+    lines = ["| series | n | min | median | max | latest | vs median | flags |",
+             "|---|---|---|---|---|---|---|---|"]
+
+    def g(v):
+        return f"{v:.4g}" if isinstance(v, (int, float)) else "-"
+
+    for series in sorted(stats):
+        s = stats[series]
+        vs = (f"{s['latest_vs_median']:.2f}x"
+              if s["latest_vs_median"] is not None else "-")
+        flags = (f"{len(s['anomalies'])} anomaly(ies)"
+                 if s["anomalies"] else "")
+        lines.append(
+            f"| {series} | {s['count']} | {g(s['min'])} | {g(s['median'])} "
+            f"| {g(s['max'])} | {g(s['latest'])} | {vs} | {flags} |")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--repo", default=_REPO,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--history", default=None, metavar="PATH",
+                    help="history file (default <repo>/results/history.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="read-only: scan + report anomalies, write nothing")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --check: exit 1 when anomalies are found "
+                         "(default is informational — report, don't block)")
+    ap.add_argument("--markdown", default=None, metavar="PATH",
+                    help="also write the trend table to PATH")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the stats as one JSON object")
+    args = ap.parse_args(argv)
+
+    repo = os.path.abspath(args.repo)
+    if not os.path.isdir(repo):
+        print(f"perf_history: not a directory: {repo}", file=sys.stderr)
+        return 2
+    history_path = args.history or os.path.join(
+        repo, "results", "history.jsonl")
+
+    folded = fold(repo, history_path, write=not args.check)
+    entries = folded["history"]
+    if not entries:
+        print("perf_history: no perf artifacts found — nothing to fold",
+              file=sys.stderr)
+        return 2
+    stats = series_stats(entries)
+    n_anom = sum(len(s["anomalies"]) for s in stats.values())
+
+    table = trend_table(stats)
+    if args.json:
+        print(json.dumps({
+            "perf_history": True,
+            "history": history_path,
+            "entries": len(entries),
+            "appended": len(folded["appended"]),
+            "series": stats,
+            "anomalies": n_anom,
+        }, indent=1, sort_keys=True))
+    else:
+        print("\n".join(table))
+        for series in sorted(stats):
+            for a in stats[series]["anomalies"]:
+                why = (f"robust z {a['robust_z']}" if "robust_z" in a
+                       else f"{a['rel_dev']:.0%} off median")
+                print(f"ANOMALY: {series} = {a['value']:.4g} "
+                      f"({why}; {a.get('source', '?')})")
+        verb = "would append" if args.check else "appended"
+        print(f"perf_history: {len(entries)} entr(ies) across "
+              f"{len(stats)} series, {verb} {len(folded['appended'])}, "
+              f"{n_anom} anomal(ies)")
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write("\n".join(table) + "\n")
+    if args.strict and n_anom:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -304,3 +304,38 @@ def test_worklist_merge_embeds_measured_paths(tmp_path, monkeypatch):
     wl._merge("bench_packed", {"ok": True, "commit": "deadbee", "value": 1})
     rec = json.loads(out.read_text())["bench_packed"]
     assert rec["commit"] == "deadbee" and "measured_paths" not in rec
+
+
+def test_bench_attribution_pointer_and_path_rule(tmp_path, monkeypatch):
+    """A profiler-armed measurement's op-class attribution rides next to
+    its RunReport pointer (ISSUE 18): `profile_attribution` appears in
+    the persisted record iff the sibling file exists, repo-relative like
+    `telemetry_report`; and the parent's jax-free `_attribution_path`
+    mirror agrees with obs.profiler.attribution_path_for byte for byte."""
+    import bench
+    from gameoflifewithactors_tpu.obs.profiler import attribution_path_for
+
+    for p in ("results/run.json", "a/b.json", "noext"):
+        assert bench._attribution_path(p) == attribution_path_for(p)
+
+    monkeypatch.setattr(bench, "PERSIST_PATH",
+                        str(tmp_path / "results" / "tpu_best.json"))
+    report = tmp_path / "results" / "bench_report_k.json"
+    report.parent.mkdir(parents=True)
+    report.write_text("{}")
+    rec = {"metric": "m (packed, 50% soup, tpu)", "value": 1e9,
+           "unit": "cell-updates/sec", "vs_baseline": 1.0}
+    # no attribution sibling: only the report pointer appears
+    bench._persist_if_best("packed:default:B3/S23", rec,
+                           report_path=str(report))
+    got = bench._load_persisted("packed:default:B3/S23")
+    assert got["telemetry_report"] == "results/bench_report_k.json"
+    assert "profile_attribution" not in got
+    # armed measurement: the sibling exists and the pointer rides along
+    (tmp_path / "results" / "bench_report_k.attribution.json").write_text(
+        '{"windows": 1}')
+    bench._persist_if_best("packed:default:B3/S23", {**rec, "value": 2e9},
+                           report_path=str(report))
+    got = bench._load_persisted("packed:default:B3/S23")
+    assert got["profile_attribution"] == \
+        "results/bench_report_k.attribution.json"

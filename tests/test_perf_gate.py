@@ -228,3 +228,92 @@ def test_deep_copy_safety():
     b0, c0 = copy.deepcopy(base), copy.deepcopy(cur)
     diff_lib.diff_records(base, cur)
     assert base == b0 and cur == c0
+
+
+# -- attribution blame (ISSUE 18): advisory ranking, same exit contract -------
+
+
+def _profiled(report, collective=0.1, stencil=0.5, windows=2, **classes):
+    """Attach a sampler ``profile`` section: per-window seconds * window
+    count, the cumulative shape ProfileSampler.attribution() emits."""
+    op = {"collective_permute": collective * windows,
+          "stencil": stencil * windows,
+          "copy_reshape": 0.01 * windows,
+          "infeed_host": 0.0, "other": 0.0}
+    op.update({cls: v * windows for cls, v in classes.items()})
+    report["profile"] = {"source": "device_tracks", "windows": windows,
+                         "op_class_seconds": op}
+    return report
+
+
+def test_attribution_blame_ranks_largest_contribution_delta():
+    """The acceptance sentence: "collective-permute +100%, stencil flat"
+    — normalized per window (the two runs sampled different counts),
+    largest busy-time delta first, a freshly-appeared class labeled new
+    rather than divided by zero."""
+    base = _profiled(_report(rate=1e9), windows=2)
+    cur = _profiled(_report(rate=0.5e9), collective=0.2, windows=4,
+                    infeed_host=0.05)
+    rows = diff_lib.attribution_blame(base, cur)
+    assert rows[0]["op_class"] == "collective_permute"
+    assert rows[0]["delta_pct"] == pytest.approx(1.0)
+    assert rows[0]["delta_s_per_window"] == pytest.approx(0.1)
+    by = {r["op_class"]: r for r in rows}
+    assert by["stencil"]["delta_pct"] == pytest.approx(0.0)      # flat
+    assert by["infeed_host"]["delta_pct"] is None                # new
+    text = "\n".join(diff_lib.format_blame(rows))
+    assert "collective_permute" in text and "+100%" in text
+    assert "flat" in text and "new" in text
+
+
+def test_attribution_blame_empty_without_both_sides():
+    assert diff_lib.attribution_blame(_report(), _report()) == []
+    # one-sided attribution is not enough
+    assert diff_lib.attribution_blame(_profiled(_report()), _report()) == []
+    # an all-zero profile (sampler armed, nothing captured) is absent too
+    zero = _report()
+    zero["profile"] = {"windows": 1, "op_class_seconds":
+                       {c: 0.0 for c in ("stencil", "other")}}
+    assert diff_lib.attribution_blame(zero, _profiled(_report())) == []
+    # bench records never carry attribution
+    assert diff_lib.extract_attribution(_bench()) is None
+
+
+def test_gate_verdict_blame_is_advisory():
+    """Blame rides on the verdict when both sides carry attribution,
+    and NEVER changes the status — the exit-code contract is pinned."""
+    base = _profiled(_report(rate=1e9, tick_mean=0.1))
+    bad = _profiled(_report(rate=0.5e9, tick_mean=0.5), collective=0.2)
+    v = diff_lib.gate(base, bad)
+    assert v["status"] == "regression"
+    assert v["blame"][0]["op_class"] == "collective_permute"
+    # same regression, no attribution: same status, no blame key
+    v2 = diff_lib.gate(_report(rate=1e9, tick_mean=0.1),
+                       _report(rate=0.5e9, tick_mean=0.5))
+    assert v2["status"] == "regression" and "blame" not in v2
+    # ok status with attribution: blame present, status untouched
+    v3 = diff_lib.gate(base, _profiled(_report(rate=1.01e9, tick_mean=0.1)))
+    assert v3["status"] == "ok"
+
+
+def test_gate_script_blame_section_and_exit_contract(tmp_path):
+    base = _profiled(_report(rate=1e9, tick_mean=0.1))
+    bad = _profiled(_report(rate=0.5e9, tick_mean=0.5), collective=0.2)
+    # regression with attribution: exit 1 (unchanged) + blame section
+    r = _run_gate(tmp_path, base, bad)
+    assert r.returncode == 1
+    assert "attribution blame" in r.stdout
+    assert "collective_permute" in r.stdout and "+100%" in r.stdout
+    # ok run: exit 0, no blame section in the text output
+    r2 = _run_gate(tmp_path, base, _profiled(_report(rate=1.02e9,
+                                                     tick_mean=0.1)))
+    assert r2.returncode == 0 and "attribution blame" not in r2.stdout
+    # --json carries the machine-readable rows, exit still 1
+    r3 = _run_gate(tmp_path, base, bad, "--json")
+    assert r3.returncode == 1
+    out = json.loads(r3.stdout)
+    assert out["blame"][0]["op_class"] == "collective_permute"
+    # a stale current record still skips with exit 0, attribution or not
+    stale = dict(bad, needs_recapture=True)
+    r4 = _run_gate(tmp_path, base, stale)
+    assert r4.returncode == 0 and "skipped" in r4.stdout

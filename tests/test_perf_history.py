@@ -1,0 +1,200 @@
+"""scripts/perf_history.py: the append-only perf curve + anomaly scan.
+
+Covers artifact folding from every shape the gate accepts (bench
+records, BENCH_r* driver wrappers, tpu_best stores, RunReports),
+append-only dedupe by content fingerprint (re-running never
+duplicates), median/MAD anomaly detection with the MAD==0 fallback,
+and the CLI contract: --check writes nothing, --strict turns
+anomalies into exit 1, unusable input is exit 2. Runs the script as a
+subprocess exactly as CI invokes it (stdlib-only, no package import).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "perf_history.py")
+
+_spec = importlib.util.spec_from_file_location("perf_history", _SCRIPT)
+ph = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ph)
+
+
+def _bench(value, metric="cell-updates/sec/chip, demo", at=None, **extra):
+    rec = {"metric": metric, "value": value, "unit": "cell-updates/sec",
+           **extra}
+    if at:
+        rec["recorded_at"] = at
+    return rec
+
+
+def _write(path, obj):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def _run(args, cwd=None):
+    return subprocess.run([sys.executable, _SCRIPT, *args],
+                          capture_output=True, text=True, cwd=cwd or _REPO)
+
+
+# -- shape extraction ---------------------------------------------------------
+
+
+def test_extract_entries_every_known_shape():
+    # plain bench record (+ the per-chip-equivalent companion series)
+    es = ph.extract_entries(_bench(2e12, at="2026-01-01",
+                                   single_chip_equivalent_updates_per_sec=5e11),
+                            "results/a.json")
+    assert {e["series"] for e in es} == {
+        "cell-updates/sec/chip, demo",
+        "cell-updates/sec/chip, demo [per-chip-equivalent]"}
+    # BENCH_rNN driver wrapper: measurement under "parsed"
+    es = ph.extract_entries({"n": 1, "cmd": ["x"], "rc": 0,
+                             "parsed": _bench(1e12)}, "BENCH_r01.json")
+    assert len(es) == 1 and es[0]["value"] == 1e12
+    # a store: one entry per persisted key
+    es = ph.extract_entries({"k1": _bench(1e12), "k2": _bench(2e12),
+                             "note": "not a record"}, "results/tpu_best.json")
+    assert sorted(e["value"] for e in es) == [1e12, 2e12]
+    assert es[0]["source"].startswith("results/tpu_best.json#")
+    # a RunReport: best cell-updates/sec across step metrics
+    rep = {"step_metrics": [{"cell_updates_per_sec": 3e8},
+                            {"cell_updates_per_sec": 5e8}],
+           "created_at": "2026-01-02"}
+    es = ph.extract_entries(rep, "results/tier1_cpu_report.json")
+    assert len(es) == 1 and es[0]["value"] == 5e8
+    assert es[0]["series"] == \
+        "report/tier1_cpu_report/best_cell_updates_per_sec"
+    # shapes with nothing to track
+    assert ph.extract_entries([1, 2, 3], "m.json") == []
+    assert ph.extract_entries({"weird": True}, "w.json") == []
+    # non-numeric values never become entries
+    assert ph.extract_entries(_bench("fast"), "x.json") == []
+    assert ph.extract_entries(_bench(True), "x.json") == []
+
+
+def test_fold_is_append_only_and_idempotent(tmp_path):
+    repo = str(tmp_path)
+    _write(os.path.join(repo, "BENCH_r01.json"),
+           {"parsed": _bench(1e12, at="2026-01-01")})
+    _write(os.path.join(repo, "results", "r2.json"),
+           _bench(2e12, at="2026-01-02"))
+    hist = os.path.join(repo, "results", "history.jsonl")
+    first = ph.fold(repo, hist)
+    assert len(first["appended"]) == 2
+    assert all("appended_at" in e for e in first["appended"])
+    # second fold: identical artifacts, nothing new
+    second = ph.fold(repo, hist)
+    assert second["appended"] == []
+    assert len(second["history"]) == 2
+    # the file is line-per-entry JSONL and survives a torn tail line
+    with open(hist, "a") as f:
+        f.write('{"torn": ')
+    assert len(ph.load_history(hist)) == 2
+    # a new measurement appends without rewriting old lines
+    before = open(hist).read()
+    _write(os.path.join(repo, "results", "r3.json"),
+           _bench(3e12, at="2026-01-03"))
+    third = ph.fold(repo, hist)
+    assert len(third["appended"]) == 1
+    assert open(hist).read().startswith(before)
+
+
+def test_unreadable_artifact_is_skipped_not_fatal(tmp_path, capsys):
+    repo = str(tmp_path)
+    _write(os.path.join(repo, "results", "good.json"), _bench(1e12))
+    with open(os.path.join(repo, "results", "bad.json"), "w") as f:
+        f.write("{not json")
+    entries = ph.scan_repo(repo)
+    assert len(entries) == 1
+
+
+# -- median/MAD anomaly detection ---------------------------------------------
+
+
+def _entries(series, values):
+    return [ph._entry(series, v, "u", f"2026-01-{i + 1:02d}", None, None,
+                      f"f{i}.json")
+            for i, v in enumerate(values)]
+
+
+def test_anomaly_robust_z():
+    stats = ph.series_stats(_entries("s", [100, 101, 99, 100, 150]))["s"]
+    assert stats["median"] == 100 and stats["mad"] == 1
+    assert len(stats["anomalies"]) == 1
+    a = stats["anomalies"][0]
+    assert a["value"] == 150 and a["robust_z"] > ph.ANOMALY_Z
+
+
+def test_anomaly_mad_zero_fallback():
+    """A series of identical values plus one outlier collapses the MAD
+    to zero; the 30%-of-median fallback still flags the outlier."""
+    stats = ph.series_stats(_entries("s", [100, 100, 100, 100, 150]))["s"]
+    assert stats["mad"] == 0
+    assert len(stats["anomalies"]) == 1
+    assert stats["anomalies"][0]["rel_dev"] == 0.5
+
+
+def test_no_anomaly_below_min_series():
+    stats = ph.series_stats(_entries("s", [100, 100, 900]))["s"]
+    assert stats["anomalies"] == []  # 3 < MIN_SERIES: no notion of typical
+
+
+def test_trend_table_renders_every_series():
+    stats = ph.series_stats(_entries("a", [1, 2]) + _entries("b", [3]))
+    lines = ph.trend_table(stats)
+    assert lines[0].startswith("| series |")
+    assert any("| a |" in ln for ln in lines)
+    assert any("| b |" in ln for ln in lines)
+
+
+# -- the CLI contract ---------------------------------------------------------
+
+
+def test_cli_check_is_read_only_and_strict_gates(tmp_path):
+    repo = str(tmp_path)
+    for i, v in enumerate([100.0, 100.0, 100.0, 100.0, 150.0]):
+        _write(os.path.join(repo, "results", f"r{i}.json"),
+               _bench(v, at=f"2026-01-{i + 1:02d}"))
+    hist = os.path.join(repo, "results", "history.jsonl")
+    # --check: anomalies report, nothing written, informational exit 0
+    r = _run(["--repo", repo, "--check"])
+    assert r.returncode == 0, r.stderr
+    assert "ANOMALY" in r.stdout and not os.path.exists(hist)
+    # --check --strict: the same anomaly now gates
+    r = _run(["--repo", repo, "--check", "--strict"])
+    assert r.returncode == 1
+    # a real fold writes the history and the markdown table
+    md = os.path.join(repo, "TREND.md")
+    r = _run(["--repo", repo, "--markdown", md])
+    assert r.returncode == 0
+    assert os.path.exists(hist)
+    assert open(md).read().startswith("| series |")
+    # --json emits machine-readable stats
+    r = _run(["--repo", repo, "--json"])
+    out = json.loads(r.stdout)
+    assert out["perf_history"] is True and out["anomalies"] == 1
+    assert out["appended"] == 0  # second fold: idempotent
+
+
+def test_cli_unusable_input_exits_two(tmp_path):
+    r = _run(["--repo", str(tmp_path / "nonexistent")])
+    assert r.returncode == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = _run(["--repo", str(empty), "--check"])
+    assert r.returncode == 2
+    assert "nothing to fold" in r.stderr
+
+
+def test_cli_folds_this_repos_committed_artifacts():
+    """The repo's own BENCH_*.json / results/ artifacts parse: the CI
+    invocation (--check against the checkout) always has input."""
+    r = _run(["--repo", _REPO, "--check"])
+    assert r.returncode == 0, r.stderr
+    assert "perf_history:" in r.stdout

@@ -1,0 +1,544 @@
+"""Sampling profiler (obs/profiler.py) + op-class perfetto attribution.
+
+The claims under test (ISSUE 18 acceptance criteria):
+
+- **classification** — slice names bucket into the five op classes with
+  first-match ordering (a ``broadcast_multiply_fusion`` is compute, not
+  a broadcast; an async collective-permute never reads as a copy);
+- **parser extensions** — ``perfetto_summary`` handles the edge cases
+  (empty trace, gzip vs plain byte-identical, nested/overlapping slices
+  union-counted once) and on a synthetic TPU multi-track dump takes
+  attribution from the busiest *classified* device track, never summing
+  mirror layers;
+- **measured overlap** — interval intersection of collective vs
+  interior-compute unions across device tracks; ``None`` (absent, not
+  0.0) on a host-only capture;
+- **hard overhead budget** — a window/period ratio above 10% refuses to
+  construct, and an armed-at-default run costs < 5% wall vs off;
+- **byte-compat** — with the profiler off, RunReports carry no
+  ``profile`` key at all;
+- **COST discipline** — the fleet aggregator refuses to sum the
+  per-chip profile gauges (``PerChipSumError``) while the
+  device-seconds counter still sums.
+"""
+
+import contextlib
+import gzip
+import json
+import time
+
+import pytest
+
+from gameoflifewithactors_tpu.obs import profiler as profiler_lib
+from gameoflifewithactors_tpu.obs.aggregate import (
+    PerChipSumError,
+    sum_across_procs,
+)
+from gameoflifewithactors_tpu.obs.exporter import render_prometheus
+from gameoflifewithactors_tpu.obs.profiler import (
+    MAX_DUTY_CYCLE,
+    OP_CLASSES,
+    ProfileSampler,
+    attribution_path_for,
+    classify_slice,
+)
+from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+
+# -- slice classification -----------------------------------------------------
+
+
+@pytest.mark.parametrize("name,cls", [
+    # collectives win over everything (async start/done markers included)
+    ("collective-permute-start.1", "collective_permute"),
+    ("collective_permute.2", "collective_permute"),
+    ("all-reduce.3", "collective_permute"),
+    ("ppermute", "collective_permute"),
+    ("send.1", "collective_permute"),
+    ("recv-done.4", "collective_permute"),
+    # fusions/kernels before copy_reshape: this name contains "broadcast"
+    # but is compute
+    ("broadcast_multiply_fusion", "stencil"),
+    ("fusion.12", "stencil"),
+    ("conv_general_dilated", "stencil"),
+    ("while.3", "stencil"),
+    ("dot.7", "stencil"),
+    ("goltpu.dispatch[cpu]", "stencil"),
+    # bare data movement
+    ("copy.4", "copy_reshape"),
+    ("transpose.1", "copy_reshape"),
+    ("bitcast.2", "copy_reshape"),
+    # host/infeed traffic
+    ("infeed.1", "infeed_host"),
+    ("TransferToDevice", "infeed_host"),
+    ("memcpyD2D", "infeed_host"),
+    # no pattern: other
+    ("ThunkExecutor::Execute", "other"),
+    ("jit_run", "other"),
+])
+def test_classify_slice(name, cls):
+    assert classify_slice(name) == cls
+
+
+def test_attribution_path_rule():
+    assert attribution_path_for("results/run.json") == \
+        "results/run.attribution.json"
+    assert attribution_path_for("run") == "run.attribution.json"
+
+
+# -- perfetto_summary edge cases ----------------------------------------------
+
+
+def _meta(pid, pname, threads):
+    events = [{"ph": "M", "pid": pid, "name": "process_name",
+               "args": {"name": pname}}]
+    for tid, tname in threads.items():
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": tname}})
+    return events
+
+
+def _slice(pid, tid, ts, dur, name):
+    return {"ph": "X", "pid": pid, "tid": tid, "ts": ts, "dur": dur,
+            "name": name}
+
+
+def _write_trace(path, events, gz=False):
+    payload = json.dumps({"traceEvents": events})
+    if gz:
+        with gzip.open(str(path), "wt") as f:
+            f.write(payload)
+    else:
+        path.write_text(payload)
+    return str(path)
+
+
+def test_perfetto_summary_empty_trace(tmp_path):
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    s = perfetto_summary(_write_trace(tmp_path / "t.json", []))
+    assert s["tracks"] == [] and s["device_tracks"] == 0
+    assert s["source"] is None and s["attribution_track"] is None
+    assert s["op_class_us"] == {} and s["overlap"] is None
+
+
+def test_perfetto_summary_gzip_and_plain_agree(tmp_path):
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = _meta(1, "/device:TPU:0", {1: "XLA Ops"}) + [
+        _slice(1, 1, 0, 100, "fusion.1"),
+        _slice(1, 1, 120, 30, "collective-permute.2"),
+    ]
+    plain = perfetto_summary(_write_trace(tmp_path / "t.json", events))
+    gzipped = perfetto_summary(
+        _write_trace(tmp_path / "t.json.gz", events, gz=True))
+    assert plain == gzipped
+    assert plain["source"] == "device_tracks"
+    assert plain["op_class_us"] == {"stencil": 100.0,
+                                    "collective_permute": 30.0}
+
+
+def test_perfetto_summary_nested_and_overlapping_union(tmp_path):
+    """Same-class slices that nest or overlap count their union once:
+    two overlapping 100us fusions spanning [0, 150) are 150us of
+    stencil, not 200."""
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = _meta(1, "/device:TPU:0", {1: "XLA Ops"}) + [
+        _slice(1, 1, 0, 100, "fusion.1"),
+        _slice(1, 1, 50, 100, "fusion.2"),
+        _slice(1, 1, 60, 10, "fusion.nested"),
+    ]
+    s = perfetto_summary(_write_trace(tmp_path / "t.json", events))
+    assert s["op_class_us"] == {"stencil": 150.0}
+    assert s["device_busy_us"] == 150.0
+
+
+def test_perfetto_summary_multi_track_attribution_not_summed(tmp_path):
+    """A TPU dump mirrors one device across track layers. Attribution
+    comes from the single track with the most *classified* busy time —
+    the op layer beats a busier module-mirror layer whose slices all
+    read ``other`` — and is never a sum across layers."""
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = (
+        _meta(1, "/device:TPU:0", {1: "XLA Modules", 2: "XLA Ops"})
+        + _meta(2, "/host:CPU", {9: "python"})
+        + [
+            # module layer: one big unclassifiable slice (100us, "other")
+            _slice(1, 1, 0, 100, "jit_run.1"),
+            # op layer: 80us of classified work
+            _slice(1, 2, 0, 50, "fusion.1"),
+            _slice(1, 2, 50, 30, "collective-permute.2"),
+            # host is busiest in wall time but must not win attribution
+            _slice(2, 9, 0, 500, "dispatch"),
+        ])
+    s = perfetto_summary(_write_trace(tmp_path / "t.json", events))
+    assert s["source"] == "device_tracks"
+    assert s["attribution_track"] == "device:TPU:0/XLA Ops"
+    assert s["op_class_us"] == {"stencil": 50.0, "collective_permute": 30.0}
+    # the busiest-track duty-cycle rule is unchanged: Modules layer wins
+    # device_busy_us (100 > 80), and mirrors are never summed
+    assert s["device_track"] == "device:TPU:0/XLA Modules"
+    assert s["device_busy_us"] == 100.0
+
+
+def test_perfetto_summary_measured_overlap_ratio(tmp_path):
+    """collective [0,100) vs interior compute [50,150): half the
+    collective time is hidden under compute -> ratio 0.5. Async
+    collectives on their own track line still count (overlap is
+    computed across ALL device tracks)."""
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = (
+        _meta(1, "/device:TPU:0", {1: "XLA Ops", 2: "Async ops"})
+        + [
+            _slice(1, 2, 0, 100, "collective-permute-start.1"),
+            _slice(1, 1, 50, 100, "fusion.interior"),
+        ])
+    s = perfetto_summary(_write_trace(tmp_path / "t.json", events))
+    ov = s["overlap"]
+    assert ov["collective_us"] == 100.0 and ov["compute_us"] == 100.0
+    assert ov["overlapped_us"] == 50.0
+    assert ov["ratio"] == pytest.approx(0.5)
+
+
+def test_perfetto_summary_host_only_source(tmp_path):
+    """A CPU capture has only host tracks: attribution still works
+    (labeled host_tracks) but there is no overlap section to fabricate."""
+    from gameoflifewithactors_tpu.utils.profiling import perfetto_summary
+
+    events = _meta(2, "/host:CPU", {9: "python"}) + [
+        _slice(2, 9, 0, 300, "broadcast_multiply_fusion"),
+        _slice(2, 9, 300, 100, "copy.1"),
+    ]
+    s = perfetto_summary(_write_trace(tmp_path / "t.json", events))
+    assert s["source"] == "host_tracks"
+    assert s["device_tracks"] == 0 and s["overlap"] is None
+    assert s["attribution_track"] == "host:CPU/python"
+    assert s["op_class_us"] == {"stencil": 300.0, "copy_reshape": 100.0}
+
+
+# -- ProfileSampler: folding, gauges, budget ----------------------------------
+
+
+def _fake_summary(collective=100.0, stencil=300.0, overlapped=50.0,
+                  source="device_tracks"):
+    return {
+        "source": source,
+        "tracks": [{"track": "t", "busy_us": collective + stencil}],
+        "op_class_us": {"collective_permute": collective, "stencil": stencil},
+        "overlap": ({"collective_us": collective, "compute_us": stencil,
+                     "overlapped_us": overlapped,
+                     "ratio": overlapped / collective}
+                    if source == "device_tracks" else None),
+    }
+
+
+def test_sampler_folds_windows_into_gauges_and_attribution():
+    reg = MetricsRegistry()
+    s = ProfileSampler(10.0, window_seconds=0.2, registry=reg,
+                       capture=lambda w: _fake_summary())
+    assert s.sample_once() is not None
+    assert s.sample_once() is not None
+    att = s.attribution()
+    assert att["windows"] == 2 and att["capture_errors"] == 0
+    assert att["source"] == "device_tracks" and att["per_chip"] is True
+    assert att["op_class_seconds"]["collective_permute"] == \
+        pytest.approx(200e-6)
+    assert att["op_class_seconds"]["stencil"] == pytest.approx(600e-6)
+    assert att["op_class_fraction"]["stencil"] == pytest.approx(0.75)
+    assert set(att["op_class_seconds"]) == set(OP_CLASSES)
+    assert att["halo_overlap_ratio_measured"] == pytest.approx(0.5)
+    assert att["duty_cycle"] == pytest.approx(0.02)
+    # the registry mirrors the cumulative view
+    g = reg.gauge("profile_op_class_fraction", "")
+    assert g.value(op_class="stencil", source="device_tracks") == \
+        pytest.approx(0.75)
+    c = reg.counter("profile_op_class_seconds_total", "")
+    assert c.value(op_class="collective_permute", source="device_tracks") == \
+        pytest.approx(200e-6)
+    assert reg.gauge("halo_overlap_ratio_measured", "").value() == \
+        pytest.approx(0.5)
+    assert reg.gauge("profile_duty_cycle", "").value() == pytest.approx(0.02)
+    assert reg.counter("profile_windows_total", "").value() == 2
+
+
+def test_sampler_host_only_measured_overlap_is_absent_not_zero():
+    reg = MetricsRegistry()
+    s = ProfileSampler(10.0, registry=reg,
+                       capture=lambda w: _fake_summary(source="host_tracks"))
+    s.sample_once()
+    att = s.attribution()
+    assert att["source"] == "host_tracks"
+    assert att["halo_overlap_ratio_measured"] is None
+    assert "overlap_collective_seconds" not in att
+    assert reg.gauge("halo_overlap_ratio_measured", "").value() is None
+
+
+def test_sampler_static_gauge_cross_check():
+    reg = MetricsRegistry()
+    reg.gauge("halo_overlap_ratio", "static schedule").set(0.8)
+    s = ProfileSampler(10.0, registry=reg,
+                       capture=lambda w: _fake_summary(overlapped=60.0))
+    s.sample_once()
+    att = s.attribution()
+    assert att["halo_overlap_ratio_static"] == pytest.approx(0.8)
+    assert att["halo_overlap_ratio_measured"] == pytest.approx(0.6)
+    assert att["overlap_measured_minus_static"] == pytest.approx(-0.2)
+
+
+def test_sampler_capture_errors_never_raise():
+    def boom(_w):
+        raise RuntimeError("wedged backend")
+
+    reg = MetricsRegistry()
+    s = ProfileSampler(10.0, registry=reg, capture=boom)
+    assert s.sample_once() is None
+    att = s.attribution()
+    assert att["windows"] == 0 and att["capture_errors"] == 1
+    assert reg.counter("profile_capture_errors", "").value(
+        error="RuntimeError") == 1
+
+
+def test_sampler_refuses_budget_violation(monkeypatch):
+    with pytest.raises(ValueError, match="overhead budget"):
+        ProfileSampler(1.0, window_seconds=0.2)  # 20% > 10%
+    with pytest.raises(ValueError, match="positive"):
+        ProfileSampler(0.0)
+    with pytest.raises(ValueError, match="positive"):
+        ProfileSampler(10.0, window_seconds=-1)
+    # at the budget boundary: exactly MAX_DUTY_CYCLE constructs
+    s = ProfileSampler(2.0, window_seconds=2.0 * MAX_DUTY_CYCLE,
+                       registry=MetricsRegistry(), capture=lambda w: None)
+    assert s.window / s.period == pytest.approx(MAX_DUTY_CYCLE)
+    # the env var is the default period
+    monkeypatch.setenv(profiler_lib.ENV_SAMPLE, "5.5")
+    s = ProfileSampler(registry=MetricsRegistry(), capture=lambda w: None)
+    assert s.period == 5.5
+
+
+def test_sampler_thread_captures_immediately_then_stops():
+    reg = MetricsRegistry()
+    s = ProfileSampler(3600.0, registry=reg, capture=lambda w: _fake_summary())
+    with s:
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            if s.attribution()["windows"] >= 1:
+                break
+            time.sleep(0.01)
+    # a run far shorter than one period still got its window
+    assert s.attribution()["windows"] == 1
+
+
+def test_arm_disarm_and_dispatch_annotation():
+    assert profiler_lib.active_sampler() is None
+    # unarmed: the annotation is free (a nullcontext, no jax import)
+    ctx = profiler_lib.dispatch_annotation("goltpu.dispatch[test]")
+    assert isinstance(ctx, contextlib.nullcontext)
+    s = ProfileSampler(3600.0, registry=MetricsRegistry(),
+                       capture=lambda w: None)
+    try:
+        assert profiler_lib.arm(s) is s
+        assert profiler_lib.active_sampler() is s
+        with profiler_lib.dispatch_annotation("goltpu.dispatch[test]"):
+            pass
+    finally:
+        profiler_lib.disarm()
+    assert profiler_lib.active_sampler() is None
+
+
+# -- RunReport integration: byte-compat off, profile section on ---------------
+
+
+def test_report_has_no_profile_key_when_off(tmp_path):
+    from gameoflifewithactors_tpu.obs import compile as obs_compile
+    from gameoflifewithactors_tpu.obs.report import RunReport, \
+        build_run_report
+    from gameoflifewithactors_tpu.obs.spans import SpanTracer
+
+    rep = build_run_report(tracer=SpanTracer(),
+                           compile_log=obs_compile.CompileEventLog(),
+                           config={"off": True})
+    d = rep.to_dict()
+    assert "profile" not in d
+    path = rep.save(str(tmp_path / "r.json"))
+    assert "profile" not in json.loads(open(path).read())
+    # and round-trips losslessly
+    assert RunReport.load(path).to_dict() == d
+
+
+def test_report_carries_profile_section_and_renders(tmp_path):
+    from gameoflifewithactors_tpu.obs import compile as obs_compile
+    from gameoflifewithactors_tpu.obs.report import RunReport, \
+        build_run_report
+    from gameoflifewithactors_tpu.obs.spans import SpanTracer
+
+    reg = MetricsRegistry()
+    s = ProfileSampler(10.0, registry=reg, capture=lambda w: _fake_summary())
+    s.sample_once()
+    rep = build_run_report(tracer=SpanTracer(),
+                           compile_log=obs_compile.CompileEventLog(),
+                           config={}, profile=s.attribution())
+    d = rep.to_dict()
+    assert d["profile"]["windows"] == 1
+    back = RunReport.load(rep.save(str(tmp_path / "r.json")))
+    assert back.profile == d["profile"]
+    text = "\n".join(back.summary_lines())
+    assert "sampling profiler" in text and "stencil" in text
+
+
+# -- acceptance: overhead budget, armed vs off --------------------------------
+
+
+def _workload():
+    from gameoflifewithactors_tpu import Engine
+    from gameoflifewithactors_tpu.models import seeds
+
+    e = Engine(seeds.seeded((128, 128), "glider", 2, 2), "conway")
+    e.step(60)
+    e.population()  # force completion
+
+
+def test_overhead_budget_armed_vs_off():
+    """The <5% acceptance criterion: the same workload, profiler off vs
+    armed at the default window with the minimum legal period, min of 3
+    runs each (min-of-repeats is the standard noise-robust wall
+    estimator; a small absolute epsilon absorbs CI scheduler jitter on
+    a sub-second workload)."""
+    _workload()  # warm the compile cache out of both measurements
+
+    def best_of(n=3):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            _workload()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    off = best_of()
+    sampler = ProfileSampler(2.0, registry=MetricsRegistry())
+    profiler_lib.arm(sampler)
+    try:
+        armed = best_of()
+    finally:
+        profiler_lib.disarm()
+    assert armed <= off * 1.05 + 0.3, (off, armed)
+
+
+# -- acceptance: CPU ghost run records static + measured overlap --------------
+
+
+def test_ghost_run_report_records_both_overlap_fields(tmp_path):
+    """One CPU ghost-pipeline run (2x2 mesh, gens_per_exchange=4) under
+    armed telemetry: the RunReport's profile section carries the static
+    schedule gauge AND the measured-overlap field — present as None on
+    CPU (host tracks only), never a fabricated 0.0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gameoflifewithactors_tpu.models.rules import CONWAY
+    from gameoflifewithactors_tpu.obs.report import begin_run_telemetry
+    from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops.stencil import Topology
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+    from gameoflifewithactors_tpu.parallel import sharded
+
+    m = mesh_lib.make_mesh((2, 2), jax.devices()[:4])
+    rng = np.random.default_rng(11)
+    grid = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)
+    placed = mesh_lib.device_put_sharded_grid(
+        bitpack.pack(jnp.asarray(grid)), m)
+
+    telem = begin_run_telemetry(profile_sample=4.0)
+    run = sharded.make_multi_step_packed_ghost(
+        m, CONWAY, Topology.TORUS, gens_per_exchange=4)
+    out = run(placed, 2)
+    out.block_until_ready()
+    rep = telem.finish(config={"mesh": [2, 2], "gens_per_exchange": 4})
+
+    p = rep.profile
+    assert p is not None and p["windows"] >= 1
+    # the static schedule gauge rode along for the cross-check
+    assert 0.0 < p["halo_overlap_ratio_static"] < 1.0
+    # measured overlap: the key is present, and on CPU (no device
+    # tracks) its value is None — absent, never 0.0
+    assert "halo_overlap_ratio_measured" in p
+    assert p["halo_overlap_ratio_measured"] is None
+    # the artifact round-trips with the section intact
+    saved = json.loads(open(rep.save(str(tmp_path / "ghost.json"))).read())
+    assert saved["profile"]["halo_overlap_ratio_static"] == \
+        p["halo_overlap_ratio_static"]
+
+
+# -- COST discipline: the aggregator refuses per-chip profile gauges ----------
+
+
+def test_aggregator_refuses_summing_profile_gauges():
+    def expo(**series):
+        reg = MetricsRegistry()
+        for name, value in series.items():
+            if name.endswith("_total"):
+                reg.counter(name, "c").inc(value, op_class="stencil",
+                                           source="device_tracks")
+            else:
+                reg.gauge(name, "g").set(value)
+        return render_prometheus(reg.snapshot())
+
+    per_proc = {}
+    for i, ratio in enumerate((0.4, 0.6)):
+        per_proc[f"w{i}"] = expo(
+            halo_overlap_ratio_measured=ratio,
+            profile_duty_cycle=0.02,
+            profile_overhead_ratio=0.01,
+            profile_op_class_seconds_total=1.5,
+        )
+    # per-chip ratios refuse the fleet sum — the honest view is per-proc
+    for name in ("halo_overlap_ratio_measured", "profile_duty_cycle",
+                 "profile_overhead_ratio"):
+        with pytest.raises(PerChipSumError, match="per-chip"):
+            sum_across_procs(per_proc, name)
+    # the device-seconds counter is additive and sums fine
+    assert sum_across_procs(
+        per_proc, "profile_op_class_seconds_total") == pytest.approx(3.0)
+
+
+def test_aggregator_refuses_profile_op_class_fraction():
+    reg = MetricsRegistry()
+    reg.gauge("profile_op_class_fraction", "g").set(
+        0.7, op_class="stencil", source="device_tracks")
+    per_proc = {"w0": render_prometheus(reg.snapshot())}
+    with pytest.raises(PerChipSumError, match="per-chip"):
+        sum_across_procs(per_proc, "profile_op_class_fraction")
+
+
+def test_fleet_top_shows_profiler_duty_and_overhead():
+    """scripts/fleet_top.py renders the armed-fleet visibility columns:
+    PROF (duty cycle) and PROF-OH (measured overhead) from the profile
+    gauges, '-' when unarmed or down."""
+    import importlib.util
+    import os
+
+    from gameoflifewithactors_tpu.obs.aggregate import parse_exposition
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "fleet_top.py"))
+    ft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ft)
+
+    assert ft.COLUMNS[-2:] == ("PROF", "PROF-OH")
+    reg = MetricsRegistry()
+    reg.gauge("profile_duty_cycle", "g").set(0.02)
+    reg.gauge("profile_overhead_ratio", "g").set(0.013)
+    row = ft.row_for("w0", parse_exposition(render_prometheus(reg.snapshot())))
+    assert row[-2] == "2.0%" and row[-1] == "1.3%"
+    # unarmed worker: dashes, never a fabricated zero
+    unarmed = ft.row_for("w1", parse_exposition(_exposition_empty()))
+    assert unarmed[-2] == "-" and unarmed[-1] == "-"
+    # down worker: the whole row is dashes
+    assert ft.row_for("w2", None)[-1] == "-"
+
+
+def _exposition_empty():
+    return render_prometheus(MetricsRegistry().snapshot())
