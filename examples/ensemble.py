@@ -31,6 +31,7 @@ def main(argv=None) -> None:
 
     from gameoflifewithactors_tpu.models.rules import parse_rule
     from gameoflifewithactors_tpu.ops import bitpack
+    from gameoflifewithactors_tpu.ops._jit import tracked_jit
     from gameoflifewithactors_tpu.ops.packed import multi_step_packed
     from gameoflifewithactors_tpu.ops.stencil import Topology
 
@@ -42,10 +43,11 @@ def main(argv=None) -> None:
                          dtype=np.uint8)
     packed = jnp.stack([bitpack.pack(jnp.asarray(u)) for u in grids])
 
-    # one program for the whole ensemble: vmap the multi-generation step
-    run = jax.jit(jax.vmap(
+    # one program for the whole ensemble: vmap the multi-generation step,
+    # jitted through the tracked entry point so compile events are attributed
+    run = tracked_jit(jax.vmap(
         lambda p, n: multi_step_packed(p, n, rule=rule, topology=Topology.TORUS),
-        in_axes=(0, None)))
+        in_axes=(0, None)), runner="examples.ensemble")
 
     cells = args.side * args.side
     done = 0

@@ -1,0 +1,342 @@
+"""HLO contract gate: prove runner invariants from what XLA actually emits.
+
+The lint half of this package reasons about *source*; this module
+reasons about *compiled artifacts*. Every runner family registers a
+zero-arg contract factory in ``ops/_jit.py``'s ``BUILDERS`` registry;
+the gate lowers each built runner to HLO on the 8-virtual-CPU platform
+and asserts, per runner:
+
+- **donation applied** — every position in ``donated_argnums`` carries
+  a donation marker in the lowered MLIR (``tf.aliasing_output`` for
+  plain jit donation, ``jax.buffer_donor`` for shard_map runners, where
+  aliasing is resolved at compile) and the compiled module actually
+  aliases (``input_output_alias``). This is the PR 11 bug class proved
+  end-to-end: ``donate=True`` that silently fell off a runner would
+  pass every numeric test on CPU and double HBM on hardware.
+- **zero host transfers** — no infeed/outfeed/host-callback ops in the
+  compiled HLO: a generation loop that round-trips to the host would
+  also pass CPU tests while destroying TPU throughput.
+- **collective accounting** — collective-permute byte totals equal the
+  closed-form halo models (``ghost_exchange_bytes`` /
+  ``deep_exchange_bytes``) *exactly* for the comm-avoiding runners;
+  byte totals are invariant under XLA's collective-combining passes, so
+  this is a hard contract. Instruction *counts* are not invariant (see
+  utils/profiling.collective_permute_count), so counts — and byte
+  totals of runners without a model — gate as measurements pinned in
+  ``results/hlo_contracts.json``, with perf_gate's staleness semantics:
+  a manifest pinned under a different jax version gates as
+  **"skipped (stale)"**, never "ok", while the invariants above stay
+  enforced regardless.
+
+Failures name the runner — "a collective appeared somewhere" is not
+actionable; "sharded.multi_step_packed_ghost moved 1792 bytes where
+ghost_exchange_bytes(k=4) predicts 1536" is.
+
+jax is imported lazily inside the functions that need it: this module
+lives next to the jax-free lint engine and must not poison its imports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+MANIFEST_RELPATH = os.path.join("results", "hlo_contracts.json")
+
+# fault-injection seam: name a registered runner here and the gate wraps
+# it with one extra ppermute before lowering — the committed test that
+# the gate fails *closed* (tests/test_contracts.py)
+ENV_INJECT = "GOLTPU_CONTRACT_INJECT"
+
+# ops in compiled HLO whose presence means a host round-trip; matched as
+# word fragments against instruction lines (XLA spells these several
+# ways across versions — infeed/outfeed instructions, host send/recv,
+# and the python-callback custom-calls io_callback lowers to)
+_HOST_TRANSFER_RE = re.compile(
+    r"\b(infeed|outfeed|send-to-host|recv-from-host|SendToHost|"
+    r"RecvFromHost|xla_python_cpu_callback|xla_ffi_python_cpu_callback|"
+    r"host_callback)\b")
+
+_MAIN_SIG_RE = re.compile(r"func\.func public @main\((?P<sig>.*?)\)\s*->",
+                          re.DOTALL)
+_ARG_SPLIT_RE = re.compile(r"%arg(\d+):")
+
+
+@dataclasses.dataclass
+class RunnerContracts:
+    """One runner's measured facts plus its invariant violations."""
+    name: str
+    tags: tuple
+    donated_argnums: tuple
+    donation_applied: bool
+    host_transfer_sites: List[str]
+    collective_permute_count: int
+    collective_permute_bytes: int
+    expected_collective_bytes: Optional[int]
+    collective_model: str
+    errors: List[str]
+
+    def to_manifest_entry(self) -> dict:
+        return {
+            "tags": list(self.tags),
+            "donated_argnums": list(self.donated_argnums),
+            "donation_applied": self.donation_applied,
+            "host_transfer_sites": len(self.host_transfer_sites),
+            "collective_permute_count": self.collective_permute_count,
+            "collective_permute_bytes": self.collective_permute_bytes,
+            "expected_collective_bytes": self.expected_collective_bytes,
+            "collective_model": self.collective_model,
+        }
+
+
+def load_registry() -> Dict[str, object]:
+    """Import every builder module so ``BUILDERS`` is fully populated,
+    and return it. Importing is the whole registration protocol — the
+    factories themselves stay unbuilt until the gate calls them."""
+    from ..ops import packed, stencil  # noqa: F401  (register on import)
+    from ..parallel import batched, sharded  # noqa: F401
+    from ..ops._jit import BUILDERS
+
+    return BUILDERS
+
+
+def donor_marked_args(mlir_text: str) -> List[int]:
+    """Argument positions of ``@main`` carrying a donation marker
+    (``tf.aliasing_output`` or ``jax.buffer_donor``) in lowered MLIR."""
+    m = _MAIN_SIG_RE.search(mlir_text)
+    if m is None:
+        return []
+    sig = m.group("sig")
+    # split the signature into per-%argN chunks; each chunk's attribute
+    # dict (if any) trails its tensor type
+    marks: List[int] = []
+    parts = _ARG_SPLIT_RE.split(sig)
+    # parts = [prefix, idx0, chunk0, idx1, chunk1, ...]
+    for idx, chunk in zip(parts[1::2], parts[2::2]):
+        if "tf.aliasing_output" in chunk or "jax.buffer_donor" in chunk:
+            marks.append(int(idx))
+    return marks
+
+
+def host_transfer_sites(hlo_text: str) -> List[str]:
+    """Distinct host-transfer markers present in compiled HLO."""
+    return sorted({m.group(1) for m in _HOST_TRANSFER_RE.finditer(hlo_text)})
+
+
+def _with_injected_permute(built):
+    """Wrap a built runner so its program carries one extra one-tile
+    ppermute over the first >1-sized mesh axis — the seam the
+    fails-closed test uses. Requires ``mesh``/``out_spec`` on the
+    BuiltRunner (sharded runners set them)."""
+    import functools
+
+    import jax
+
+    from ..parallel._compat import shard_map
+
+    if built.mesh is None or built.out_spec is None:
+        raise ValueError(
+            "contract injection needs mesh/out_spec on the BuiltRunner; "
+            "this runner registered without an injection seam")
+    mesh, spec = built.mesh, built.out_spec
+    axis = next(a for a in mesh.axis_names if mesh.shape[a] > 1)
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    inner = getattr(built.lowerable, "jitted", built.lowerable)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec)
+    def _shift(tile):
+        return jax.lax.ppermute(tile, axis, perm)
+
+    def fn(*args, **kwargs):
+        return _shift(inner(*args, **kwargs))
+
+    # keep the original donation so the injected build fails on exactly
+    # one contract — the collective accounting — not as collateral
+    # goltpu: ignore[GOL006] -- deliberately-broken build for the fails-closed test; must NOT enter compile accounting
+    return jax.jit(fn, donate_argnums=built.donated_argnums,
+                   static_argnames=tuple(built.example_kwargs))
+
+
+def check_runner(spec, *, inject: bool = False) -> RunnerContracts:
+    """Build, lower, and compile one registered runner; return its
+    measured contract facts with every invariant violation spelled out
+    (each error string leads with the runner name)."""
+    from ..utils import profiling
+
+    built = spec.factory()
+    lowerable = (_with_injected_permute(built) if inject
+                 else built.lowerable)
+    lowered = lowerable.lower(*built.example_args, **built.example_kwargs)
+    mlir = lowered.as_text()
+    hlo = lowered.compile().as_text()
+
+    errors: List[str] = []
+    donation_applied = True
+    if built.donated_argnums:
+        marked = donor_marked_args(mlir)
+        missing = [i for i in built.donated_argnums if i not in marked]
+        aliased = ("input_output_alias" in hlo
+                   or "tf.aliasing_output" in mlir)
+        donation_applied = not missing and aliased
+        if missing:
+            errors.append(
+                f"{spec.name}: donation NOT applied to arg position(s) "
+                f"{missing} — the lowered program carries no donation "
+                "marker there (the PR 11 bug class: donate=True fell off "
+                "the runner)")
+        elif not aliased:
+            errors.append(
+                f"{spec.name}: buffer donor marked but the compiled "
+                "module shows no input_output_alias — XLA dropped the "
+                "aliasing, so donation buys no memory on this build")
+
+    host = host_transfer_sites(hlo)
+    if host:
+        errors.append(
+            f"{spec.name}: host transfer(s) in compiled HLO: "
+            f"{', '.join(host)} — generation loops must stay on-device")
+
+    cp_count = profiling.collective_permute_count(hlo)
+    cp_bytes = profiling.collective_permute_bytes(hlo)
+    if (built.expected_collective_bytes is not None
+            and cp_bytes != built.expected_collective_bytes):
+        errors.append(
+            f"{spec.name}: collective-permute bytes {cp_bytes} != "
+            f"closed-form {built.expected_collective_bytes} "
+            f"({built.collective_model or 'model'})")
+
+    return RunnerContracts(
+        name=spec.name, tags=tuple(spec.tags),
+        donated_argnums=tuple(built.donated_argnums),
+        donation_applied=donation_applied,
+        host_transfer_sites=host,
+        collective_permute_count=cp_count,
+        collective_permute_bytes=cp_bytes,
+        expected_collective_bytes=built.expected_collective_bytes,
+        collective_model=built.collective_model,
+        errors=errors)
+
+
+def check_all(only: Optional[Sequence[str]] = None,
+              inject: Optional[str] = None) -> List[RunnerContracts]:
+    """Check every registered runner (or the ``only`` subset), in name
+    order so output and manifests are diffable. ``inject`` names one
+    runner to run through the fault-injection seam."""
+    registry = load_registry()
+    names = sorted(registry)
+    if only:
+        unknown = [n for n in only if n not in registry]
+        if unknown:
+            raise KeyError(
+                f"unknown runner(s) {unknown}; registered: {names}")
+        names = sorted(only)
+    return [check_runner(registry[n], inject=(n == inject)) for n in names]
+
+
+# -- the frozen manifest ------------------------------------------------------
+
+
+def jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def build_manifest(results: Sequence[RunnerContracts]) -> dict:
+    return {
+        "jax": jax_version(),
+        "platform": "cpu",
+        "generated_by": "scripts/contract_check.py --write",
+        "runners": {r.name: r.to_manifest_entry() for r in results},
+    }
+
+
+def load_manifest(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_manifest(manifest: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def gate(results: Sequence[RunnerContracts], frozen: Optional[dict],
+         *, strict: bool = False, complete: bool = True) -> List[str]:
+    """Per-runner status lines: ``ok NAME ...``, ``skipped (stale)
+    NAME ...``, or ``FAIL NAME: reason``. Invariants (donation, zero
+    host transfers, closed-form bytes) fail regardless of manifest
+    state; pinned count/byte comparisons need a fresh manifest — same
+    jax version as the run — and gate as skipped-stale otherwise,
+    never silently ok (scripts/perf_gate.py semantics). ``strict``
+    additionally fails runners the manifest does not pin (CI mode: an
+    unpinned runner is an unreviewed contract)."""
+    lines: List[str] = []
+    pinned = (frozen or {}).get("runners", {})
+    fresh = frozen is not None and frozen.get("jax") == jax_version()
+    for r in results:
+        for e in r.errors:
+            lines.append(f"FAIL {e}")
+        if r.errors:
+            continue
+        entry = pinned.get(r.name)
+        if entry is None:
+            if strict:
+                lines.append(
+                    f"FAIL {r.name}: not pinned in the manifest — "
+                    "regenerate with scripts/contract_check.py --write "
+                    "and review the diff")
+            else:
+                lines.append(f"unpinned {r.name} (count="
+                             f"{r.collective_permute_count} bytes="
+                             f"{r.collective_permute_bytes})")
+            continue
+        if not fresh:
+            pinned_jax = (frozen or {}).get("jax", "<unknown>")
+            lines.append(
+                f"skipped (stale) {r.name}: manifest pinned under jax "
+                f"{pinned_jax}, running {jax_version()} — invariants "
+                "enforced, pinned counts not comparable; regenerate "
+                "with --write")
+            continue
+        tol = int(entry.get("count_tolerance", 0))
+        want_count = entry.get("collective_permute_count")
+        want_bytes = entry.get("collective_permute_bytes")
+        if (want_count is not None
+                and abs(r.collective_permute_count - want_count) > tol):
+            lines.append(
+                f"FAIL {r.name}: collective-permute count "
+                f"{r.collective_permute_count} != pinned {want_count} "
+                f"(tolerance {tol}) — an extra (or missing) collective "
+                "changed this runner's program")
+            continue
+        if (want_bytes is not None
+                and r.collective_permute_bytes != want_bytes):
+            lines.append(
+                f"FAIL {r.name}: collective-permute bytes "
+                f"{r.collective_permute_bytes} != pinned {want_bytes}")
+            continue
+        lines.append(
+            f"ok {r.name} (count={r.collective_permute_count} "
+            f"bytes={r.collective_permute_bytes}"
+            + (f" model={r.collective_model}" if r.collective_model
+               else "") + ")")
+    # a runner the manifest pins but the registry lost is a contract
+    # silently un-proved — fail loud, someone deleted a registration
+    # (``complete=False`` for --only runs, which check a subset)
+    have = {r.name for r in results}
+    for name in sorted(set(pinned) - have) if complete else ():
+        lines.append(
+            f"FAIL {name}: pinned in the manifest but no longer "
+            "registered — if the runner was removed on purpose, "
+            "regenerate the manifest with --write")
+    return lines

@@ -1,0 +1,815 @@
+"""Flow-sensitive AST analyses feeding rules GOL008–GOL010 — stdlib only.
+
+The GOL001–007 rules are line-local pattern matches; the two worst bugs
+in this repo's history were *flow* bugs they could not see. The PR 11
+donated-buffer use-after-free was a value (``jnp.asarray(caller_numpy)``)
+travelling three hops — ``__init__`` store, ``self.state`` load, donated
+call — before the aliasing mattered; deadlocks live in the *order* two
+locks are taken across classes, not in any single ``with``. This module
+holds the def-use / graph machinery those rules need, kept separate from
+rules.py so the analyses stay testable on their own and reusable (the
+rules are thin adapters that turn analysis results into Findings).
+
+Three analyses:
+
+- :func:`donation_alias_findings` — per-module def-use tracking of
+  caller-owned buffers through aliasing producers (``jnp.asarray``,
+  ``jnp.array(copy=False)``, view-forwarding helpers, ``self`` attribute
+  stores) into donated argument positions, plus re-reads of a name after
+  it was donated. ``jnp.array(x, copy=True)`` breaks the chain — that is
+  the shipped PR 11 fix and the negative fixture.
+- :class:`LockGraph` — project-wide lock-acquisition graph over the
+  classes of ``obs/``, ``serve/`` and ``resilience/``: nodes are
+  ``Class.lock_attr``, edges are "acquired while holding" (nested
+  ``with``, self-method calls under a lock, cross-object calls through
+  constructor-typed attributes). Cycles and cross-class
+  acquire-while-holding are the GOL009 findings.
+- :func:`collect_metric_decls` / :func:`per_chip_gauge_names` — the
+  constant-string metric declarations (``*.counter/gauge/histogram``)
+  and the ``PER_CHIP_GAUGES`` set parsed out of ``obs/aggregate.py``,
+  for GOL010's naming/membership/kind-collision checks.
+
+Like every rule helper: heuristic on purpose, tuned for zero false
+positives on this tree. When a chain cannot be proven, it is dropped —
+not guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# -- tiny shared helpers (rules.py imports these) -----------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.fori_loop' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return None
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+def lock_attr_types(cls: ast.ClassDef) -> Dict[str, str]:
+    """``self`` attributes assigned a threading.Lock()/RLock() anywhere
+    in the class (typically __init__), mapped to which kind — the
+    distinction matters: re-acquiring a plain Lock self-deadlocks."""
+    locks: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            kind = d.split(".")[-1]
+            if kind in ("Lock", "RLock"):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        locks[t.attr] = kind
+    return locks
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when ``node`` is exactly ``self.x``."""
+    if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _walk_scope(root: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does NOT descend into nested function/lambda bodies:
+    their parameters shadow the enclosing scope (two sibling lambdas both
+    taking ``s`` share nothing), so flow facts must not leak across."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and child is not root:
+                continue
+            stack.append(child)
+
+
+# =============================================================================
+# donation aliasing (GOL008)
+# =============================================================================
+
+# what the donated-position heuristic assumes: in this codebase every
+# ``donate=True`` opt-in (ops/_jit.optionally_donated, the make_* runner
+# factories) donates the FIRST positional argument of the eventual call
+_DONATED_POS_DEFAULT = (0,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Alias:
+    """A value proven to share the caller's buffer."""
+
+    root: str       # the caller-owned name it aliases ("np_grid", a param)
+    producer: str   # "jnp.asarray", "jnp.array(copy=False)", "helper()"
+    line: int       # where the alias was made (for the message)
+
+
+def _is_aliasing_call(call: ast.Call) -> Optional[str]:
+    """'jnp.asarray'-style producers that may return a view of their
+    first argument rather than a copy. ``jnp.array`` copies by default —
+    only an explicit ``copy=False`` aliases. Returns a producer label,
+    or None for copying/unknown calls."""
+    d = dotted(call.func) or ""
+    tail = d.split(".")[-1]
+    if not call.args:
+        return None
+    if tail == "asarray":
+        return d
+    if tail == "array":
+        for kw in call.keywords:
+            if kw.arg == "copy" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return f"{d}(copy=False)"
+        return None
+    return None
+
+
+def _owned_base(expr: ast.AST) -> Optional[ast.AST]:
+    """Unwrap view-preserving syntax (subscripts like ``x[None]`` — numpy
+    slices are views) down to the Name/self-attr whose buffer is shared."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Name) or _self_attr(expr) is not None:
+        return expr
+    return None
+
+
+class _FnAnalysis:
+    """One function's linear pass: environment of proven aliases plus
+    donation events, statements visited in source order."""
+
+    def __init__(self, owner: "_DonationAnalysis", fn: ast.AST,
+                 owned: Set[str], attr_aliases: Dict[str, Alias],
+                 fn_label: str):
+        self.owner = owner
+        self.fn = fn
+        self.owned = owned              # caller-owned names (parameters)
+        self.attr_aliases = attr_aliases  # class-wide: attr -> Alias
+        self.fn_label = fn_label
+        self.env: Dict[str, Alias] = {}
+        self.donated_at: Dict[str, Tuple[int, str]] = {}  # name -> (line, callee)
+        self.findings: List[Tuple[ast.AST, str]] = []
+
+    # - alias environment ----------------------------------------------------
+
+    def _alias_of(self, expr: ast.AST) -> Optional[Alias]:
+        """The Alias a value expression carries, if provable."""
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id)
+        attr = _self_attr(expr)
+        if attr is not None:
+            return self.attr_aliases.get(attr)
+        if isinstance(expr, ast.Call):
+            producer = _is_aliasing_call(expr)
+            if producer is not None:
+                root = self._caller_owned_root(expr.args[0])
+                if root is not None:
+                    return Alias(root=root, producer=producer,
+                                 line=expr.lineno)
+                inner = self._alias_of(expr.args[0])
+                if inner is not None:  # asarray of an alias stays an alias
+                    return Alias(root=inner.root, producer=inner.producer,
+                                 line=inner.line)
+            # one level of helper forwarding: y = prep(buf) where
+            # ``def prep(x): return jnp.asarray(x)``
+            fname = dotted(expr.func)
+            if fname is not None:
+                idx = self.owner.forwarders.get(fname.split(".")[-1])
+                if idx is not None and idx < len(expr.args):
+                    root = self._caller_owned_root(expr.args[idx])
+                    if root is not None:
+                        return Alias(
+                            root=root, line=expr.lineno,
+                            producer=f"{fname}() (returns an alias of "
+                                     f"its argument)")
+        return None
+
+    def _caller_owned_root(self, expr: ast.AST) -> Optional[str]:
+        """Name of the caller-owned buffer ``expr`` shares, if any."""
+        base = _owned_base(expr)
+        if base is None:
+            return None
+        if isinstance(base, ast.Name):
+            if base.id in self.owned:
+                return base.id
+            inner = self.env.get(base.id)
+            return inner.root if inner else None
+        return None
+
+    # - donation sites -------------------------------------------------------
+
+    def _donated_positions(self, call: ast.Call) -> Tuple[int, ...]:
+        """Which positional args of this call hand their buffer to XLA."""
+        fname = dotted(call.func)
+        tail = (fname or "").split(".")[-1]
+        # explicit per-call opt-in: f(state, n, donate=True). On a
+        # ``make_*`` factory (or a local alias of one) the flag
+        # configures the *returned* runner (the assignment pass tracks
+        # that), not this call's args.
+        if not tail.startswith("make_") \
+                and tail not in self.owner.factory_aliases:
+            for kw in call.keywords:
+                if kw.arg == "donate" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    return _DONATED_POS_DEFAULT
+        # a callable known to donate (factory built with donate=True, or
+        # jit with constant donate_argnums)
+        if fname is not None:
+            pos = self.owner.donating_callables.get(fname)
+            if pos:
+                return pos
+        return ()
+
+    # - the walk -------------------------------------------------------------
+
+    def run(self) -> None:
+        body = self.fn.body if not isinstance(self.fn, ast.Lambda) else []
+        for stmt in body:
+            self._stmt(stmt)
+        self._check_reads_after_donation()
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.fn:
+            return  # nested scope: analyzed on its own
+        if isinstance(node, ast.Assign):
+            self._visit_calls(node.value)
+            alias = self._alias_of(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if alias is not None:
+                        self.env[t.id] = alias
+                    else:
+                        self.env.pop(t.id, None)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self._visit_calls(node.value)
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._stmt(child)
+
+    def _visit_calls(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Lambda):
+            return  # its own scope: param names shadow ours
+        for sub in _walk_scope(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+
+    def _check_call(self, call: ast.Call) -> None:
+        positions = self._donated_positions(call)
+        if not positions:
+            return
+        callee = dotted(call.func) or "<call>"
+        for pos in positions:
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            alias = self._alias_of(arg)
+            if alias is not None:
+                self.findings.append((call, (
+                    f"donated argument of `{callee}` aliases caller-owned "
+                    f"buffer '{alias.root}' (via {alias.producer} at line "
+                    f"{alias.line}): donation invalidates the caller's "
+                    f"array in place — the PR 11 use-after-free; copy "
+                    f"first with jnp.array(x, copy=True)")))
+            # remember what was donated for the re-read check; the call's
+            # end line is the threshold so a multi-line call site does
+            # not flag its own argument
+            name = None
+            if isinstance(arg, ast.Name):
+                name = arg.id
+            elif _self_attr(arg) is not None:
+                name = f"self.{_self_attr(arg)}"
+            if name is not None and name not in self.donated_at:
+                self.donated_at[name] = (
+                    call.lineno, getattr(call, "end_lineno", None)
+                    or call.lineno, callee)
+
+    def _check_reads_after_donation(self) -> None:
+        """A Load of a donated name on a later line — with no intervening
+        re-assignment — reads a buffer XLA now owns."""
+        if not self.donated_at:
+            return
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[ast.AST]] = {}
+        for node in _walk_scope(self.fn):
+            if isinstance(node, ast.Name):
+                key = node.id
+            else:
+                attr = _self_attr(node)
+                if attr is None:
+                    continue
+                key = f"self.{attr}"
+            if key not in self.donated_at:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                stores.setdefault(key, []).append(node.lineno)
+            elif isinstance(node.ctx, ast.Load):
+                loads.setdefault(key, []).append(node)
+        for key, (dline, dend, callee) in self.donated_at.items():
+            for node in loads.get(key, []):
+                if node.lineno <= dend:
+                    continue
+                if any(dline <= s <= node.lineno
+                       for s in stores.get(key, [])):
+                    continue  # rebound before the read: the usual
+                    # ``state = run(state, n)`` swap
+                self.findings.append((node, (
+                    f"`{key}` read after being donated to `{callee}` at "
+                    f"line {dline}: the buffer belongs to XLA once the "
+                    f"call dispatches — keep a copy, or re-read the "
+                    f"call's result instead")))
+                break  # one finding per donated name is enough
+
+
+class _DonationAnalysis:
+    """Module-level orchestration: donating callables, forwarding
+    helpers, per-class attribute aliases, then every function body."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        # dotted callee name -> donated positional indices
+        self.donating_callables: Dict[str, Tuple[int, ...]] = {}
+        # helper name -> index of the param its return value aliases
+        self.forwarders: Dict[str, int] = {}
+        # local names bound to a make_* runner factory (``make = sharded.
+        # make_multi_step_packed``): calling one with donate=True
+        # configures the runner it RETURNS, it donates nothing itself
+        self.factory_aliases: Set[str] = set()
+        self.findings: List[Tuple[ast.AST, str]] = []
+        self._collect_module_facts()
+
+    # - module pass ----------------------------------------------------------
+
+    @staticmethod
+    def _jit_donated_positions(call: ast.Call,
+                               params: List[str]) -> Tuple[int, ...]:
+        """Constant donate_argnums/argnames of a jit-like call."""
+        out: List[int] = []
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                out.extend(const_int_tuple(kw.value) or ())
+            elif kw.arg == "donate_argnames":
+                for nm in const_str_tuple(kw.value) or ():
+                    if nm in params:
+                        out.append(params.index(nm))
+        return tuple(sorted(set(out)))
+
+    @staticmethod
+    def _call_has_donate_true(call: ast.Call) -> bool:
+        return any(kw.arg == "donate" and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in call.keywords)
+
+    @classmethod
+    def _lambda_donated_positions(cls, lam: ast.Lambda) -> Tuple[int, ...]:
+        """``lambda s, n: f(s, n, donate=True)`` donates whichever of ITS
+        params land in the wrapped call's donated slots — the Engine's
+        backend-closure idiom."""
+        if not isinstance(lam.body, ast.Call):
+            return ()
+        call = lam.body
+        tail = (dotted(call.func) or "").split(".")[-1]
+        if tail.startswith("make_") or not cls._call_has_donate_true(call):
+            return ()
+        params = param_names(lam)
+        out = []
+        for pos in _DONATED_POS_DEFAULT:
+            if pos < len(call.args) and isinstance(call.args[pos],
+                                                   ast.Name) \
+                    and call.args[pos].id in params:
+                out.append(params.index(call.args[pos].id))
+        return tuple(out)
+
+    def _collect_module_facts(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = param_names(node)
+                # decorated defs that donate on every call
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        tail = (dotted(dec.func) or "").split(".")[-1]
+                        if tail in ("tracked_jit", "jit"):
+                            pos = self._jit_donated_positions(dec, params)
+                            if pos:
+                                self.donating_callables[node.name] = pos
+                        elif tail == "partial" and dec.args:
+                            inner = (dotted(dec.args[0]) or "").split(".")[-1]
+                            if inner in ("tracked_jit", "jit"):
+                                pos = self._jit_donated_positions(
+                                    dec, params)
+                                if pos:
+                                    self.donating_callables[node.name] = pos
+                # forwarding helpers: a single-return body whose value
+                # aliases a parameter
+                if len(node.body) >= 1:
+                    ret = node.body[-1]
+                    if isinstance(ret, ast.Return) and ret.value is not None:
+                        idx = self._forwarded_param(ret.value, params)
+                        if idx is not None:
+                            self.forwarders[node.name] = idx
+            elif isinstance(node, ast.Assign):
+                pos: Tuple[int, ...] = ()
+                if isinstance(node.value, ast.Call):
+                    call = node.value
+                    tail = (dotted(call.func) or "").split(".")[-1]
+                    if tail in ("tracked_jit", "jit"):
+                        pos = self._jit_donated_positions(call, [])
+                    elif self._call_has_donate_true(call):
+                        # run = make_multi_step_*(mesh, rule, donate=True):
+                        # the returned runner consumes its first argument
+                        pos = _DONATED_POS_DEFAULT
+                elif isinstance(node.value, ast.Lambda):
+                    pos = self._lambda_donated_positions(node.value)
+                else:
+                    # bare factory references: make = sharded.make_* (or
+                    # an IfExp choosing between factories)
+                    tails = {(dotted(sub) or "").split(".")[-1]
+                             for sub in ast.walk(node.value)
+                             if isinstance(sub, (ast.Name, ast.Attribute))}
+                    if any(t.startswith("make_") for t in tails):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.factory_aliases.add(t.id)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.donating_callables[t.id] = pos
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            self.donating_callables[f"self.{attr}"] = pos
+
+    @staticmethod
+    def _forwarded_param(expr: ast.AST, params: List[str]) -> Optional[int]:
+        if isinstance(expr, ast.Call) and _is_aliasing_call(expr):
+            base = _owned_base(expr.args[0])
+            if isinstance(base, ast.Name) and base.id in params:
+                return params.index(base.id)
+        return None
+
+    # - function passes ------------------------------------------------------
+
+    def run(self) -> List[Tuple[ast.AST, str]]:
+        # classes first: attribute aliases cross method boundaries
+        class_fns: Set[int] = set()
+        for cls in [n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            attr_aliases = self._class_attr_aliases(cls)
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_fns.add(id(fn))
+                    owned = set(param_names(fn)) - {"self", "cls"}
+                    fa = _FnAnalysis(self, fn, owned, attr_aliases,
+                                     f"{cls.name}.{fn.name}")
+                    fa.run()
+                    self.findings.extend(fa.findings)
+        for fn in ast.walk(self.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(fn) not in class_fns:
+                fa = _FnAnalysis(self, fn, set(param_names(fn)), {},
+                                 fn.name)
+                fa.run()
+                self.findings.extend(fa.findings)
+        return self.findings
+
+    def _class_attr_aliases(self, cls: ast.ClassDef) -> Dict[str, Alias]:
+        """self attributes that alias a caller-owned buffer in ANY method
+        (an aliased store is sticky: one clean re-assignment elsewhere
+        does not un-alias the caller's copy)."""
+        out: Dict[str, Alias] = {}
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            owned = set(param_names(fn)) - {"self", "cls"}
+            fa = _FnAnalysis(self, fn, owned, {}, fn.name)
+            for stmt in (fn.body if not isinstance(fn, ast.Lambda) else []):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    alias = fa._alias_of(node.value)
+                    # track locals so chained stores resolve
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and alias is not None:
+                            fa.env[t.id] = alias
+                        attr = _self_attr(t)
+                        if attr is not None and alias is not None \
+                                and attr not in out:
+                            out[attr] = alias
+        return out
+
+
+def donation_alias_findings(tree: ast.Module) -> List[Tuple[ast.AST, str]]:
+    """GOL008's engine: (node, message) pairs for caller-buffer aliases
+    reaching donated call positions and reads-after-donation."""
+    return _DonationAnalysis(tree).run()
+
+
+# =============================================================================
+# lock-order graph (GOL009)
+# =============================================================================
+
+
+@dataclasses.dataclass
+class _Acquisition:
+    """One 'acquired B while holding A' event inside a method."""
+
+    held: str                      # lock attr currently held (same class)
+    target: Tuple                  # ("lock", attr) | ("self", meth)
+    #                              | ("attr", obj_attr, meth)
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class ClassLockSummary:
+    """Everything the project pass needs to know about one class."""
+
+    path: str
+    name: str
+    locks: Dict[str, str]                    # lock attr -> Lock | RLock
+    attr_types: Dict[str, str]               # self._x = Cls(...) -> Cls
+    entry_acquires: Dict[str, List[Tuple[str, ast.AST]]]  # method ->
+    #                                        locks taken while holding none
+    held_events: Dict[str, List[_Acquisition]]            # method -> events
+
+
+def summarize_class_locks(cls: ast.ClassDef, path: str) -> ClassLockSummary:
+    locks = lock_attr_types(cls)
+    attr_types: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor and ctor.split(".")[-1][:1].isupper():
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        attr_types[attr] = ctor.split(".")[-1]
+    entry: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    events: Dict[str, List[_Acquisition]] = {}
+
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        e_list: List[Tuple[str, ast.AST]] = []
+        ev_list: List[_Acquisition] = []
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in locks:
+                        acquired.append((attr, item.context_expr))
+                for attr, site in acquired:
+                    if held:
+                        ev_list.append(_Acquisition(
+                            held=held[-1], target=("lock", attr),
+                            node=site))
+                    else:
+                        e_list.append((attr, site))
+                new_held = held + tuple(a for a, _ in acquired)
+                for child in node.body:
+                    walk(child, new_held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, ast.Call) and held:
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    owner = _self_attr(f.value)
+                    if isinstance(f.value, ast.Name) \
+                            and f.value.id == "self":
+                        ev_list.append(_Acquisition(
+                            held=held[-1], target=("self", f.attr),
+                            node=node))
+                    elif owner is not None:
+                        ev_list.append(_Acquisition(
+                            held=held[-1],
+                            target=("attr", owner, f.attr), node=node))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for child in fn.body:
+            walk(child, ())
+        if e_list:
+            entry[fn.name] = e_list
+        if ev_list:
+            events[fn.name] = ev_list
+
+    return ClassLockSummary(path=path, name=cls.name, locks=locks,
+                            attr_types=attr_types, entry_acquires=entry,
+                            held_events=events)
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """src lock-node acquires dst lock-node while held."""
+
+    src: str                       # "Class.attr"
+    dst: str
+    path: str                      # module emitting the edge
+    node: ast.AST
+    how: str                       # human phrasing for the finding
+    cross_class: bool
+
+
+class LockGraph:
+    """The project-wide acquired-while-holding graph."""
+
+    def __init__(self, summaries: Iterable[ClassLockSummary]):
+        self.classes: Dict[str, ClassLockSummary] = {}
+        for s in summaries:
+            if s.locks:
+                self.classes[s.name] = s
+        self.edges: List[LockEdge] = []
+        # (summary, method, ast node, description) — re-entry of a plain
+        # threading.Lock, the guaranteed-deadlock case. Self-loop edges
+        # never enter the graph: RLock re-entry is legal and a plain-Lock
+        # re-entry is reported here, not as a "cycle".
+        self.self_deadlocks: List[Tuple[ClassLockSummary, str,
+                                        ast.AST, str]] = []
+        self._build()
+
+    def _node(self, cls: str, attr: str) -> str:
+        return f"{cls}.{attr}"
+
+    def _build(self) -> None:
+        for s in self.classes.values():
+            for meth, events in s.held_events.items():
+                for ev in events:
+                    src = self._node(s.name, ev.held)
+                    kind = ev.target[0]
+                    if kind == "lock":
+                        attr = ev.target[1]
+                        if attr == ev.held:
+                            if s.locks.get(attr) == "Lock":
+                                self.self_deadlocks.append((
+                                    s, meth, ev.node,
+                                    f"{s.name}.{meth} nests `with "
+                                    f"self.{attr}` inside `with "
+                                    f"self.{attr}`"))
+                            continue
+                        self.edges.append(LockEdge(
+                            src=src,
+                            dst=self._node(s.name, attr),
+                            path=s.path, node=ev.node,
+                            how=f"{s.name}.{meth} nests "
+                                f"`with self.{attr}` inside "
+                                f"`with self.{ev.held}`",
+                            cross_class=False))
+                    elif kind == "self":
+                        callee = ev.target[1]
+                        for attr, _ in s.entry_acquires.get(callee, []):
+                            if attr == ev.held:
+                                if s.locks.get(attr) == "Lock":
+                                    self.self_deadlocks.append((
+                                        s, meth, ev.node,
+                                        f"{s.name}.{meth} calls "
+                                        f"self.{callee}() while holding "
+                                        f"self.{ev.held}, and {callee} "
+                                        f"re-acquires self.{ev.held}"))
+                                continue
+                            self.edges.append(LockEdge(
+                                src=src, dst=self._node(s.name, attr),
+                                path=s.path, node=ev.node,
+                                how=f"{s.name}.{meth} calls "
+                                    f"self.{callee}() (which takes "
+                                    f"self.{attr}) while holding "
+                                    f"self.{ev.held}",
+                                cross_class=False))
+                    else:
+                        _, obj_attr, callee = ev.target
+                        tcls = self.classes.get(
+                            s.attr_types.get(obj_attr, ""))
+                        if tcls is None:
+                            continue
+                        for attr, _ in tcls.entry_acquires.get(callee, []):
+                            self.edges.append(LockEdge(
+                                src=src,
+                                dst=self._node(tcls.name, attr),
+                                path=s.path, node=ev.node,
+                                how=f"{s.name}.{meth} calls "
+                                    f"self.{obj_attr}.{callee}() (which "
+                                    f"takes {tcls.name}.{attr}) while "
+                                    f"holding self.{ev.held}",
+                                cross_class=True))
+
+    def cycles(self) -> List[List[LockEdge]]:
+        """Elementary cycles in the acquisition graph, each reported once
+        (deduped on the canonical node set)."""
+        adj: Dict[str, List[LockEdge]] = {}
+        for e in self.edges:
+            adj.setdefault(e.src, []).append(e)
+        seen_sets: Set[frozenset] = set()
+        out: List[List[LockEdge]] = []
+
+        def dfs(node: str, path_edges: List[LockEdge],
+                on_path: Dict[str, int]) -> None:
+            for e in adj.get(node, []):
+                if e.dst in on_path:
+                    cyc = path_edges[on_path[e.dst]:] + [e]
+                    key = frozenset(x.src for x in cyc)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(cyc)
+                    continue
+                on_path[e.dst] = len(path_edges) + 1
+                dfs(e.dst, path_edges + [e], on_path)
+                del on_path[e.dst]
+
+        for start in sorted(adj):
+            dfs(start, [], {start: 0})
+        return out
+
+
+# =============================================================================
+# metric discipline (GOL010)
+# =============================================================================
+
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricDecl:
+    name: str
+    kind: str        # counter | gauge | histogram
+    path: str
+    node: ast.AST = dataclasses.field(compare=False, hash=False)
+
+
+def collect_metric_decls(tree: ast.Module, path: str) -> List[MetricDecl]:
+    """Constant-string ``*.counter/gauge/histogram("name", ...)`` calls.
+    Dynamic names are invisible to the registry-discipline checks on
+    purpose — guessing would produce noise, and the runtime registry
+    still enforces kind conflicts for those."""
+    out: List[MetricDecl] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_KINDS):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        out.append(MetricDecl(name=node.args[0].value,
+                              kind=node.func.attr, path=path, node=node))
+    return out
+
+
+def per_chip_gauge_names(tree: ast.Module) -> Optional[Set[str]]:
+    """The literal ``PER_CHIP_GAUGES`` set out of obs/aggregate.py's AST,
+    or None when no such constant assignment exists."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "PER_CHIP_GAUGES"
+                   for t in node.targets):
+            continue
+        names: Set[str] = set()
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                names.add(sub.value)
+        return names
+    return None

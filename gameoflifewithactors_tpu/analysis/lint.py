@@ -13,6 +13,9 @@ Three layers:
 
 - **Rule registry** — rules register under a stable ``GOLxxx`` code via
   :func:`register`; each is a callable ``(ModuleContext) -> [Finding]``.
+  *Project* rules (:func:`register_project`) see every parsed module at
+  once — ``(ProjectContext) -> [Finding]`` — for cross-file invariants
+  like lock ordering (GOL009) and metric-name discipline (GOL010).
   The codes are API: pragmas and baselines reference them, so a rule may
   be retired but its code never reused.
 - **Pragmas** — ``# goltpu: ignore[GOL006] -- reason`` suppresses
@@ -86,6 +89,11 @@ class Rule:
 
 RULES: Dict[str, Rule] = {}
 
+# cross-file rules: ``check`` takes a ProjectContext (every parsed module
+# in the run) instead of one ModuleContext. Same code space as RULES —
+# pragmas and baselines cannot tell the layers apart, by design.
+PROJECT_RULES: Dict[str, Rule] = {}
+
 
 def register(code: str, name: str, summary: str):
     """Decorator: file a rule under ``code`` (stable, never reused)."""
@@ -93,9 +101,26 @@ def register(code: str, name: str, summary: str):
         raise ValueError(f"rule code must match GOLnnn, got {code!r}")
 
     def deco(fn):
-        if code in RULES:
+        if code in RULES or code in PROJECT_RULES:
             raise ValueError(f"duplicate rule code {code}")
         RULES[code] = Rule(code=code, name=name, summary=summary, check=fn)
+        return fn
+
+    return deco
+
+
+def register_project(code: str, name: str, summary: str):
+    """Decorator: file a *project-level* rule — its check runs once per
+    lint run over a :class:`ProjectContext` and may emit findings against
+    any scanned file (per-file pragmas still suppress them)."""
+    if not _CODE_RE.match(code):
+        raise ValueError(f"rule code must match GOLnnn, got {code!r}")
+
+    def deco(fn):
+        if code in RULES or code in PROJECT_RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        PROJECT_RULES[code] = Rule(code=code, name=name, summary=summary,
+                                   check=fn)
         return fn
 
     return deco
@@ -129,6 +154,25 @@ class ModuleContext:
                        line=getattr(node, "lineno", 1),
                        col=getattr(node, "col_offset", 0),
                        message=message)
+
+
+@dataclasses.dataclass
+class ProjectContext:
+    """What a project-level rule may look at: every module that parsed,
+    in scan order. Findings are emitted via the owning module's
+    :meth:`ModuleContext.finding` so pragma suppression keeps working."""
+
+    modules: List[ModuleContext]
+
+    def module(self, path_suffix: str) -> Optional[ModuleContext]:
+        """First scanned module whose path ends with ``path_suffix``
+        (e.g. ``"obs/aggregate.py"``), or None if it was not scanned —
+        rules use this to gate sub-checks that need a specific anchor
+        file rather than guessing from a partial tree."""
+        for m in self.modules:
+            if m.path.endswith(path_suffix):
+                return m
+        return None
 
 
 # -- pragmas ------------------------------------------------------------------
@@ -270,16 +314,16 @@ class LintResult:
         }
 
 
-def lint_source(source: str, path: str,
-                rules: Optional[Dict[str, Rule]] = None) -> FileReport:
-    """Lint one file's source. SyntaxError surfaces as FileReport.error
-    (bad input), never as an exception — the CLI keeps scanning."""
-    rules = RULES if rules is None else rules
+def _lint_file(source: str, path: str, rules: Dict[str, Rule]):
+    """Per-file pass. Returns (FileReport, ModuleContext | None, by_line
+    pragma map) — the context and pragma map feed the project-rule pass,
+    which must route its findings through the same suppression."""
     try:
         ctx = ModuleContext.from_source(source, path)
     except SyntaxError as exc:
-        return FileReport(path=path, findings=[], suppressed=[],
-                          error=f"{path}: not parseable as Python: {exc}")
+        return (FileReport(path=path, findings=[], suppressed=[],
+                           error=f"{path}: not parseable as Python: {exc}"),
+                None, {})
     pragmas = parse_pragmas(source)
     by_line: Dict[int, List[Pragma]] = {}
     for p in pragmas:
@@ -293,7 +337,39 @@ def lint_source(source: str, path: str,
             suppressed.append(f)
         else:
             findings.append(f)
-    return FileReport(path=ctx.path, findings=findings, suppressed=suppressed)
+    return (FileReport(path=ctx.path, findings=findings,
+                       suppressed=suppressed), ctx, by_line)
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Dict[str, Rule]] = None) -> FileReport:
+    """Lint one file's source with the per-file rules. SyntaxError
+    surfaces as FileReport.error (bad input), never as an exception —
+    the CLI keeps scanning. Project rules need the whole run's modules
+    and so only fire from lint_paths/lint_sources."""
+    return _lint_file(source, path, RULES if rules is None else rules)[0]
+
+
+def _apply_project_rules(reports_by_path, ctxs, by_lines,
+                         project_rules: Optional[Dict[str, Rule]]) -> None:
+    """Run the cross-file rules and fold their findings into the owning
+    FileReports, honoring that file's pragmas."""
+    prules = PROJECT_RULES if project_rules is None else project_rules
+    if not ctxs or not prules:
+        return
+    pctx = ProjectContext(modules=list(ctxs))
+    for rule in prules.values():
+        for f in rule.check(pctx):
+            rep = reports_by_path.get(f.path)
+            if rep is None or rep.error is not None:
+                continue  # rules only emit against scanned modules
+            if _suppressed_by(f, by_lines.get(f.path, {})):
+                rep.suppressed.append(f)
+            else:
+                rep.findings.append(f)
+    for rep in reports_by_path.values():
+        rep.findings.sort(key=lambda f: (f.line, f.col, f.code))
+        rep.suppressed.sort(key=lambda f: (f.line, f.col, f.code))
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
@@ -310,34 +386,8 @@ def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
                         yield os.path.join(root, name)
 
 
-def lint_paths(paths: Iterable[str], *,
-               baseline: Optional[List[dict]] = None,
-               rules: Optional[Dict[str, Rule]] = None) -> LintResult:
-    """Lint files/trees; apply the baseline; aggregate."""
-    reports: List[FileReport] = []
-    errors: List[str] = []
-    seen = set()
-    any_path = False
-    for path in paths:
-        any_path = True
-        if not os.path.exists(path):
-            errors.append(f"{path}: no such file or directory")
-            continue
-        for fp in iter_python_files([path]):
-            if fp in seen:
-                continue
-            seen.add(fp)
-            try:
-                with open(fp, encoding="utf-8") as f:
-                    src = f.read()
-            except OSError as exc:
-                reports.append(FileReport(path=fp, findings=[],
-                                          suppressed=[],
-                                          error=f"{fp}: {exc}"))
-                continue
-            reports.append(lint_source(src, fp, rules=rules))
-    if not any_path:
-        errors.append("no paths given")
+def _aggregate(reports: List[FileReport], errors: List[str],
+               baseline: Optional[List[dict]]) -> LintResult:
     baseline_keys = {(e["code"], e["path"], e["message"])
                      for e in (baseline or [])}
     matched_keys = set()
@@ -360,6 +410,76 @@ def lint_paths(paths: Iterable[str], *,
     return LintResult(findings=findings, suppressed=suppressed,
                       baselined=baselined, unused_baseline=unused,
                       files=reports, errors=errors)
+
+
+def _run_lint(files, pre_errors: List[str],
+              baseline: Optional[List[dict]],
+              rules: Optional[Dict[str, Rule]],
+              project_rules: Optional[Dict[str, Rule]]) -> LintResult:
+    """Shared core: ``files`` is [(path, source | None, error | None)] —
+    per-file rules, then project rules, then the baseline."""
+    per_file = RULES if rules is None else rules
+    reports: List[FileReport] = []
+    reports_by_path: Dict[str, FileReport] = {}
+    ctxs: List[ModuleContext] = []
+    by_lines: Dict[str, Dict[int, List[Pragma]]] = {}
+    for path, source, error in files:
+        if error is not None:
+            rep = FileReport(path=path, findings=[], suppressed=[],
+                             error=error)
+        else:
+            rep, ctx, by_line = _lint_file(source, path, per_file)
+            if ctx is not None:
+                ctxs.append(ctx)
+                by_lines[ctx.path] = by_line
+        reports.append(rep)
+        reports_by_path[rep.path] = rep
+    _apply_project_rules(reports_by_path, ctxs, by_lines, project_rules)
+    return _aggregate(reports, list(pre_errors), baseline)
+
+
+def lint_sources(sources: Dict[str, str], *,
+                 baseline: Optional[List[dict]] = None,
+                 rules: Optional[Dict[str, Rule]] = None,
+                 project_rules: Optional[Dict[str, Rule]] = None
+                 ) -> LintResult:
+    """Lint an in-memory {path: source} set as one run — the project
+    rules see all of them together. This is how cross-file rule fixtures
+    are pinned without touching disk."""
+    return _run_lint([(p, s, None) for p, s in sources.items()],
+                     [], baseline, rules, project_rules)
+
+
+def lint_paths(paths: Iterable[str], *,
+               baseline: Optional[List[dict]] = None,
+               rules: Optional[Dict[str, Rule]] = None,
+               project_rules: Optional[Dict[str, Rule]] = None
+               ) -> LintResult:
+    """Lint files/trees; run per-file then project rules; apply the
+    baseline; aggregate."""
+    files = []
+    errors: List[str] = []
+    seen = set()
+    any_path = False
+    for path in paths:
+        any_path = True
+        if not os.path.exists(path):
+            errors.append(f"{path}: no such file or directory")
+            continue
+        for fp in iter_python_files([path]):
+            if fp in seen:
+                continue
+            seen.add(fp)
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError as exc:
+                files.append((fp, None, f"{fp}: {exc}"))
+                continue
+            files.append((fp, src, None))
+    if not any_path:
+        errors.append("no paths given")
+    return _run_lint(files, errors, baseline, rules, project_rules)
 
 
 # registering the built-in rules populates RULES as a side effect; the

@@ -1,4 +1,4 @@
-"""The built-in goltpu-lint rules (GOL001…GOL007).
+"""The built-in goltpu-lint rules (GOL001…GOL010).
 
 Each rule encodes one invariant this codebase actually depends on — the
 failure classes the telemetry layer (obs/) can only report after the
@@ -7,6 +7,11 @@ tuned to zero false positives on this tree (tests/test_lint.py pins a
 positive and a negative fixture per rule). When a rule cannot decide, it
 stays quiet — a linter that cries wolf gets pragma'd into silence, which
 is worse than a narrow one.
+
+GOL001–007 are line-local; GOL008 is flow-sensitive within a module and
+GOL009/GOL010 are *project* rules (analysis/dataflow.py holds their
+def-use and graph machinery; lint.register_project runs them once over
+every scanned module).
 
 | code   | invariant                                                    |
 | ------ | ------------------------------------------------------------ |
@@ -25,6 +30,16 @@ is worse than a narrow one.
 | GOL007 | obs/ classes that own a ``_lock`` READ their ``self._cache`` |
 |        | scrape-cache state only under it (GOL004 covers writes; a    |
 |        | torn read of a (stamp, payload) tuple is just as racy)       |
+| GOL008 | no alias of a caller-owned buffer (jnp.asarray/              |
+|        | array(copy=False) of a parameter) may reach a donated call   |
+|        | position, and no name is re-read after being donated —       |
+|        | the PR 11 use-after-free class                               |
+| GOL009 | the obs/serve/resilience lock-acquisition graph is acyclic   |
+|        | and never re-enters a plain Lock; cross-class                |
+|        | acquire-while-holding must be pragma-justified               |
+| GOL010 | registry counters end ``_total``; per-chip-shaped gauges are |
+|        | listed in obs/aggregate.py PER_CHIP_GAUGES; no metric name   |
+|        | is declared under two different kinds                        |
 """
 
 from __future__ import annotations
@@ -32,7 +47,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .lint import Finding, ModuleContext, register
+from . import dataflow
+from .lint import Finding, ModuleContext, ProjectContext, register, \
+    register_project
 
 # ``x.shape``/``x.dtype``-style reads are trace-time constants even on a
 # traced array: branching on them is fine, syncing on them impossible
@@ -47,16 +64,9 @@ _MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
              "remove", "clear", "update", "setdefault", "add", "discard"}
 
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'jax.lax.fori_loop' for nested Attribute/Name chains, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+# shared AST helpers live in dataflow.py since GOL008+ (the analyses
+# need them too); the underscored aliases keep this module's idiom
+_dotted = dataflow.dotted
 
 
 def _is_jax_jit(node: ast.AST) -> bool:
@@ -78,32 +88,9 @@ def _is_partial(node: ast.AST) -> bool:
     return _dotted(node) in ("partial", "functools.partial")
 
 
-def _const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
-    if isinstance(node, (ast.Tuple, ast.List)) and all(
-            isinstance(e, ast.Constant) and isinstance(e.value, str)
-            for e in node.elts):
-        return tuple(e.value for e in node.elts)
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return (node.value,)
-    return None
-
-
-def _const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
-    if isinstance(node, (ast.Tuple, ast.List)) and all(
-            isinstance(e, ast.Constant) and isinstance(e.value, int)
-            for e in node.elts):
-        return tuple(e.value for e in node.elts)
-    if isinstance(node, ast.Constant) and isinstance(node.value, int):
-        return (node.value,)
-    return None
-
-
-def _param_names(fn: ast.AST) -> List[str]:
-    a = fn.args
-    names = [p.arg for p in getattr(a, "posonlyargs", [])]
-    names += [p.arg for p in a.args]
-    names += [p.arg for p in a.kwonlyargs]
-    return names
+_const_str_tuple = dataflow.const_str_tuple
+_const_int_tuple = dataflow.const_int_tuple
+_param_names = dataflow.param_names
 
 
 def _static_names_from_jit_kwargs(keywords, params: List[str]) -> Set[str]:
@@ -375,17 +362,7 @@ def _unconditional_donation(ctx: ModuleContext) -> Iterable[Finding]:
 def _lock_attr_names(cls: ast.ClassDef) -> Set[str]:
     """Attributes assigned a threading.Lock()/RLock() anywhere in the
     class (typically __init__)."""
-    locks: Set[str] = set()
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign) and isinstance(
-                node.value, ast.Call):
-            d = _dotted(node.value.func) or ""
-            if d.split(".")[-1] in ("Lock", "RLock"):
-                for t in node.targets:
-                    if isinstance(t, ast.Attribute) and isinstance(
-                            t.value, ast.Name) and t.value.id == "self":
-                        locks.add(t.attr)
-    return locks
+    return set(dataflow.lock_attr_types(cls))
 
 
 @register("GOL004", "lock-discipline",
@@ -540,6 +517,159 @@ def _wall_clock(ctx: ModuleContext) -> Iterable[Finding]:
                 "(monotonic), instrumented phases want obs.spans.span() "
                 "so the RunReport sees them; a genuine wall-clock stamp "
                 "needs a pragma saying so"))
+    return out
+
+
+# -- GOL006: jit outside the choke point --------------------------------------
+
+
+@register("GOL008", "donation-aliasing",
+          "no caller-buffer alias may reach a donated call position")
+def _donation_aliasing(ctx: ModuleContext) -> Iterable[Finding]:
+    """The PR 11 bug class, caught before the soak: a value made by
+    ``jnp.asarray(param)`` / ``jnp.array(param, copy=False)`` shares the
+    caller's buffer — donating it (directly, via a ``self`` attribute
+    stored in one method and donated in another, or through a
+    view-forwarding helper) invalidates memory the caller still holds.
+    The shipped fix, ``jnp.array(x, copy=True)``, breaks the alias chain
+    and stays clean; so does the rebind-after-donate idiom
+    ``state = run(state, n)``. Re-reading a name after it was donated
+    (without a rebind) is flagged for the same reason."""
+    return [ctx.finding("GOL008", node, msg)
+            for node, msg in dataflow.donation_alias_findings(ctx.tree)]
+
+
+# -- GOL009: lock-order across obs/serve/resilience ---------------------------
+
+_LOCK_ORDER_DIRS = ("obs/", "serve/", "resilience/")
+
+
+def _in_lock_order_scope(path: str) -> bool:
+    return any(f"/{d}" in path or path.startswith(d)
+               for d in _LOCK_ORDER_DIRS)
+
+
+@register_project("GOL009", "lock-order",
+                  "the cross-class lock-acquisition graph must be acyclic")
+def _lock_order(pctx: ProjectContext) -> Iterable[Finding]:
+    """GOL004/007 prove each access holds *a* lock; this rule proves the
+    locks compose. It builds the acquired-while-holding graph across the
+    threaded subsystems (obs/, serve/, resilience/) — nested ``with``,
+    self-method calls under a lock, cross-object calls through
+    constructor-typed attributes — and flags (a) re-entering a plain
+    ``threading.Lock`` (guaranteed self-deadlock), (b) cycles (deadlock
+    under the right interleaving), and (c) cross-class
+    acquire-while-holding, which is where future cycles come from and
+    must carry a pragma explaining why the callee can never call back."""
+    by_path = {}
+    summaries = []
+    for m in pctx.modules:
+        if not _in_lock_order_scope(m.path) or m.in_tests:
+            continue
+        for cls in [n for n in ast.walk(m.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            s = dataflow.summarize_class_locks(cls, m.path)
+            if s.locks:
+                summaries.append(s)
+        by_path[m.path] = m
+    if not summaries:
+        return []
+    graph = dataflow.LockGraph(summaries)
+    out: List[Finding] = []
+
+    def emit(path: str, node: ast.AST, msg: str) -> None:
+        m = by_path.get(path)
+        if m is not None:
+            out.append(m.finding("GOL009", node, msg))
+
+    for s, meth, node, desc in graph.self_deadlocks:
+        emit(s.path, node,
+             f"self-deadlock: {desc} — threading.Lock is not reentrant; "
+             "inline the locked body or switch to an unlocked _locked() "
+             "helper")
+    for cyc in graph.cycles():
+        chain = " -> ".join([e.src for e in cyc] + [cyc[0].src])
+        e = cyc[-1]
+        emit(e.path, e.node,
+             f"lock-order cycle {chain}: {e.how} closes the cycle — two "
+             "threads entering from different ends deadlock; impose one "
+             "global acquisition order or drop the lock before the call")
+    # a call into a lock-LEAF class (one that never calls out while
+    # holding its own lock — e.g. a pure store) cannot deadlock today;
+    # flag only callees that themselves acquire-and-call, which is where
+    # the next cycle comes from
+    outgoing = {e.src.split(".")[0] for e in graph.edges}
+    for e in graph.edges:
+        if e.cross_class and e.dst.split(".")[0] in outgoing:
+            emit(e.path, e.node,
+                 f"cross-class acquire-while-holding: {e.how} — if "
+                 f"{e.dst.split('.')[0]} ever calls back under its lock "
+                 "this deadlocks; move the call outside the lock or "
+                 "pragma why the callee cannot re-enter")
+    return out
+
+
+# -- GOL010: metric-name discipline -------------------------------------------
+
+_PER_CHIP_SUFFIXES = ("_per_sec", "_ratio", "_fraction", "_duty_cycle")
+
+
+@register_project("GOL010", "metric-discipline",
+                  "metric names follow the registry/aggregation contract")
+def _metric_discipline(pctx: ProjectContext) -> Iterable[Finding]:
+    """Today these contracts only fail in production: a counter without
+    ``_total`` breaks the PromQL conventions the dashboards assume, a
+    per-chip gauge missing from PER_CHIP_GAUGES gets silently summed
+    across the fleet (the exact bug PerChipSumError exists to refuse),
+    and a name declared as both gauge and histogram raises at import
+    time on whichever process loads both modules. All three are visible
+    in the AST. Tests are exempt (throwaway metric names are the point
+    there); the per-chip membership check only runs when
+    obs/aggregate.py is part of the scanned tree."""
+    decls: List[dataflow.MetricDecl] = []
+    by_path = {m.path: m for m in pctx.modules}
+    for m in pctx.modules:
+        if m.in_tests:
+            continue
+        decls.extend(dataflow.collect_metric_decls(m.tree, m.path))
+    out: List[Finding] = []
+
+    def emit(d: dataflow.MetricDecl, msg: str) -> None:
+        m = by_path.get(d.path)
+        if m is not None:
+            out.append(m.finding("GOL010", d.node, msg))
+
+    per_chip: Optional[Set[str]] = None
+    agg = pctx.module("obs/aggregate.py")
+    if agg is not None:
+        per_chip = dataflow.per_chip_gauge_names(agg.tree)
+
+    for d in decls:
+        if d.kind == "counter" and not d.name.endswith("_total"):
+            emit(d, f"counter '{d.name}' does not end in '_total': the "
+                    "fleet plane and dashboards key on the Prometheus "
+                    "counter convention — rename, or pragma why this "
+                    "series name is frozen")
+        if d.kind == "gauge" and per_chip is not None \
+                and d.name not in per_chip \
+                and (d.name.startswith("hbm_")
+                     or d.name.endswith(_PER_CHIP_SUFFIXES)):
+            emit(d, f"per-chip-shaped gauge '{d.name}' is not listed in "
+                    "obs/aggregate.py PER_CHIP_GAUGES: fleet aggregation "
+                    "would sum it across chips into a meaningless number "
+                    "— add it to the set (or pragma why summing is "
+                    "correct here)")
+
+    kinds: Dict[str, dataflow.MetricDecl] = {}
+    flagged: Set[Tuple[str, str]] = set()
+    for d in decls:
+        first = kinds.setdefault(d.name, d)
+        if first.kind != d.kind and (d.name, d.path) not in flagged:
+            flagged.add((d.name, d.path))
+            emit(d, f"metric '{d.name}' declared as {d.kind} here but as "
+                    f"{first.kind} in {first.path}: "
+                    "MetricsRegistry raises on the kind conflict at "
+                    "runtime — rename one of them")
     return out
 
 

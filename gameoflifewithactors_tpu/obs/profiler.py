@@ -195,6 +195,7 @@ class ProfileSampler:
         except Exception as exc:
             with self._lock:
                 self._errors += 1
+            # goltpu: ignore[GOL010] -- series name frozen pre-_total convention: committed history.jsonl/RunReports key on it
             self.registry.counter(
                 "profile_capture_errors",
                 "profiler capture windows that raised").inc(

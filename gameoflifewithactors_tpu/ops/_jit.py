@@ -24,8 +24,9 @@ reporting first-tick compile time as step time.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 
@@ -99,3 +100,72 @@ def optionally_donated(
         return wrapper
 
     return deco
+
+
+# -- runner-builder registry (the HLO contract gate's enumeration) -----------
+#
+# Being the jit choke point makes this module the one place every runner
+# family already imports, so the registry of *contract factories* lives
+# here too: each builder module (parallel/sharded.py, parallel/batched.py,
+# ops/packed.py, ops/stencil.py) registers zero-arg factories that the
+# contract gate (analysis/contracts.py, scripts/contract_check.py) calls
+# to obtain a lowerable runner plus the invariants to prove about it —
+# donation really applied, zero host transfers, collective traffic equal
+# to the closed-form halo model. Registration must stay import-cheap:
+# factories build meshes and example grids only when the gate runs them.
+
+
+@dataclasses.dataclass(frozen=True)
+class BuiltRunner:
+    """A contract factory's product.
+
+    ``lowerable`` must expose ``.lower(*example_args, **example_kwargs)``
+    — tracked_jit wrappers, optionally_donated ``.jitted_donating``
+    instances, and raw ``jax.jit`` objects all do.
+
+    ``expected_collective_bytes`` is the closed-form interconnect model
+    (ghost_exchange_bytes / deep_exchange_bytes) the compiled HLO's
+    collective-permute byte total must equal *exactly* — byte accounting
+    is invariant under XLA's collective-combining passes, so this is a
+    hard contract. Instruction *counts* are not invariant (see
+    utils/profiling.collective_permute_count), so they gate as pinned
+    manifest measurements with jax-version staleness instead. ``None``
+    means no byte model applies (single-device runners: the contract is
+    then zero collectives).
+
+    ``mesh``/``out_spec`` let the gate's fault-injection seam wrap the
+    runner with one extra ppermute (GOLTPU_CONTRACT_INJECT) to prove the
+    gate actually fails closed; single-device runners leave them None.
+    """
+    lowerable: Callable
+    example_args: tuple
+    example_kwargs: dict = dataclasses.field(default_factory=dict)
+    donated_argnums: Tuple[int, ...] = ()
+    expected_collective_bytes: Optional[int] = None
+    collective_model: str = ""
+    mesh: Optional[object] = None
+    out_spec: Optional[object] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class BuilderSpec:
+    name: str
+    factory: Callable[[], BuiltRunner]
+    tags: Tuple[str, ...] = ()
+
+
+BUILDERS: Dict[str, BuilderSpec] = {}
+
+
+def register_builder(name: str, factory: Callable = None, *,
+                     tags: Sequence[str] = ()):
+    """Register a zero-arg contract factory under ``name`` (usable as a
+    decorator factory or called directly). Duplicate names are refused:
+    the manifest keys on them, so a silent overwrite would let one
+    runner's contracts mask another's."""
+    if factory is None:
+        return lambda f: register_builder(name, f, tags=tags)
+    if name in BUILDERS:
+        raise ValueError(f"duplicate builder registration: {name!r}")
+    BUILDERS[name] = BuilderSpec(name=name, factory=factory, tags=tuple(tags))
+    return factory

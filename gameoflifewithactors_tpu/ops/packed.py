@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.rules import Rule
-from ._jit import optionally_donated
+from ._jit import BuiltRunner, optionally_donated, register_builder
 from .stencil import Topology
 
 _TOP_BIT = 31  # bit index holding the highest column of a word
@@ -283,3 +283,23 @@ def step_packed_ext(ext: jax.Array, rule: Rule) -> jax.Array:
     """One generation on a halo-extended tile; returns the (h, wp) interior."""
     alive, bits = count_bits_ext(ext)
     return apply_rule_planes(alive, bits, rule)
+
+
+# -- contract-gate registration (ops/_jit.py BUILDERS) -----------------------
+
+
+@register_builder("ops.multi_step_packed", tags=("ops", "packed"))
+def _contract_ops_multi_step_packed():
+    import numpy as np
+
+    from ..models.rules import CONWAY
+    from . import bitpack
+
+    rng = np.random.default_rng(7)
+    p = bitpack.pack(jnp.asarray(
+        rng.integers(0, 2, size=(64, 128), dtype=np.uint8)))
+    return BuiltRunner(
+        lowerable=multi_step_packed.jitted_donating,
+        example_args=(p, 3), example_kwargs={"rule": CONWAY},
+        donated_argnums=(0,), expected_collective_bytes=0,
+        collective_model="single-device: zero collectives")

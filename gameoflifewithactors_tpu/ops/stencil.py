@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.rules import Rule
-from ._jit import optionally_donated
+from ._jit import BuiltRunner, optionally_donated, register_builder
 
 
 class Topology(enum.Enum):
@@ -100,3 +100,21 @@ def multi_step(
     """
     body = lambda _, s: apply_rule(s, neighbor_counts(s, topology), rule)
     return jax.lax.fori_loop(0, n, body, state)
+
+
+# -- contract-gate registration (ops/_jit.py BUILDERS) -----------------------
+
+
+@register_builder("ops.multi_step", tags=("ops", "dense"))
+def _contract_ops_multi_step():
+    import numpy as np
+
+    from ..models.rules import CONWAY
+
+    rng = np.random.default_rng(7)
+    state = jnp.asarray(rng.integers(0, 2, size=(64, 128), dtype=np.uint8))
+    return BuiltRunner(
+        lowerable=multi_step.jitted_donating,
+        example_args=(state, 3), example_kwargs={"rule": CONWAY},
+        donated_argnums=(0,), expected_collective_bytes=0,
+        collective_model="single-device: zero collectives")

@@ -19,10 +19,10 @@ import numpy as np
 from ._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.rules import Rule
+from ..models.rules import CONWAY, Rule
 from ..ops.packed import step_packed_ext
 from ..ops.stencil import Topology
-from ..ops._jit import tracked_jit
+from ..ops._jit import BuiltRunner, register_builder, tracked_jit
 from .halo import exchange_halo
 from .mesh import COL_AXIS, ROW_AXIS
 
@@ -186,3 +186,44 @@ def make_multi_step_pallas_batched(
 
     return tracked_jit(_run, runner="batched.multi_step_pallas_batched",
                        donate_argnums=(0,) if donate else ())
+
+
+# -- contract-gate registrations (ops/_jit.py BUILDERS) ----------------------
+
+
+def _contract_batch_example(mesh_shape=(2, 2, 2), grid=(64, 128), seed=7):
+    import jax.numpy as jnp
+
+    from ..ops import bitpack
+
+    nb, nx, ny = mesh_shape
+    m = make_batch_mesh(mesh_shape, jax.devices()[: nb * nx * ny])
+    rng = np.random.default_rng(seed)
+    soup = rng.integers(0, 2, size=(nb,) + grid, dtype=np.uint8)
+    packed = jnp.stack([bitpack.pack(jnp.asarray(u)) for u in soup])
+    return m, jax.device_put(packed, batch_sharding(m))
+
+
+@register_builder("batched.multi_step_packed_batched",
+                  tags=("batched", "packed"))
+def _contract_multi_step_packed_batched():
+    m, grids = _contract_batch_example()
+    return BuiltRunner(
+        lowerable=make_multi_step_packed_batched(m, CONWAY, Topology.TORUS,
+                                                 donate=True),
+        example_args=(grids, 8), donated_argnums=(0,), mesh=m,
+        out_spec=_SPEC)
+
+
+@register_builder("batched.multi_step_packed_batched_masked",
+                  tags=("batched", "packed", "serving"))
+def _contract_multi_step_packed_batched_masked():
+    import jax.numpy as jnp
+
+    m, grids = _contract_batch_example()
+    mask = jnp.ones((grids.shape[0],), jnp.uint32)
+    return BuiltRunner(
+        lowerable=make_multi_step_packed_batched(
+            m, CONWAY, Topology.TORUS, donate=True, masked=True),
+        example_args=(grids, 8, mask), donated_argnums=(0,), mesh=m,
+        out_spec=_SPEC)

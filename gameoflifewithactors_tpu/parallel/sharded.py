@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.rules import Rule
+from ..models.rules import CONWAY, Rule
 from ..ops import packed as packed_ops
-from ..ops._jit import tracked_jit
+from ..ops._jit import BuiltRunner, register_builder, tracked_jit
 from ..ops import stencil as stencil_ops
 from ..ops.stencil import Topology
 from .halo import (
@@ -524,6 +524,28 @@ def make_multi_step_packed_deep(
         return jax.lax.fori_loop(0, chunks, lambda _, t: chunk(t), tile)
 
     return _tracked(_run, "sharded.multi_step_packed_deep", donate)
+
+
+def deep_exchange_bytes(grid_shape, mesh: Mesh, topology: Topology,
+                        gens_per_exchange: int) -> int:
+    """Interconnect bytes ONE deep-chunk exchange moves fleet-wide for a
+    packed (H, Wp) grid on ``mesh``: depth-g row strips (g rows × tile
+    words) per row-neighbor pair, then 1-word column strips of the
+    row-*extended* tile (h + 2g rows) per column-neighbor pair — exactly
+    the ``exchange_cols(exchange_rows(tile, depth=g), depth=1)`` trip of
+    :func:`make_multi_step_packed_deep`'s chunk. Self-sends on a size-1
+    TORUS axis count zero, matching
+    utils/profiling.collective_permute_bytes; the contract gate asserts
+    this model equals the compiled HLO's byte total exactly."""
+    g = int(gens_per_exchange)
+    nx, ny = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    h, wq = int(grid_shape[-2]) // nx, int(grid_shape[-1]) // ny
+    itemsize = 4  # packed uint32 words
+    wrap = topology is Topology.TORUS
+    row_sends = (2 * ny * (nx if wrap else nx - 1)) if nx > 1 else 0
+    col_sends = (2 * nx * (ny if wrap else ny - 1)) if ny > 1 else 0
+    return (row_sends * g * wq * itemsize
+            + col_sends * (h + 2 * g) * itemsize)
 
 
 def ghost_exchange_bytes(grid_shape, mesh: Mesh, topology: Topology,
@@ -1172,3 +1194,119 @@ def make_multi_step_dense(mesh: Mesh, rule: Rule, topology: Topology = Topology.
                           donate: bool = False) -> Callable:
     return _make_runner(mesh, rule, topology, _dense_ext_step, multi=True,
                         donate=donate, runner="sharded.multi_step_dense")
+
+
+# -- contract-gate registrations (ops/_jit.py BUILDERS) ----------------------
+#
+# Zero-arg factories the HLO contract gate (analysis/contracts.py,
+# scripts/contract_check.py) enumerates: each builds a donation-enabled
+# runner on a small mesh with a deterministically-seeded example grid
+# (the tests/test_ghost.py harness idiom) and states the invariants to
+# prove against its compiled HLO. Registration is a dict insert; meshes
+# and grids are built only when the gate calls the factory.
+
+
+def _contract_example(mesh_shape=(2, 2), grid=(64, 128), *,
+                      packed=True, banded=False, seed=7):
+    import numpy as np
+
+    from ..ops import bitpack
+    from . import mesh as mesh_lib
+
+    n = mesh_shape[0] * mesh_shape[1]
+    m = mesh_lib.make_mesh(mesh_shape, jax.devices()[:n])
+    rng = np.random.default_rng(seed)
+    g = jnp.asarray(rng.integers(0, 2, size=grid, dtype=np.uint8))
+    placed = mesh_lib.device_put_sharded_grid(
+        bitpack.pack(g) if packed else g, m, banded=banded)
+    return m, placed
+
+
+@register_builder("sharded.step_packed", tags=("sharded", "packed"))
+def _contract_step_packed():
+    m, p = _contract_example()
+    return BuiltRunner(
+        lowerable=make_step_packed(m, CONWAY, Topology.TORUS, donate=True),
+        example_args=(p,), donated_argnums=(0,), mesh=m, out_spec=_SPEC)
+
+
+@register_builder("sharded.multi_step_packed", tags=("sharded", "packed"))
+def _contract_multi_step_packed():
+    m, p = _contract_example()
+    return BuiltRunner(
+        lowerable=make_multi_step_packed(m, CONWAY, Topology.TORUS,
+                                         donate=True),
+        example_args=(p, 8), donated_argnums=(0,), mesh=m, out_spec=_SPEC)
+
+
+@register_builder("sharded.step_dense", tags=("sharded", "dense"))
+def _contract_step_dense():
+    m, g = _contract_example(packed=False)
+    return BuiltRunner(
+        lowerable=make_step_dense(m, CONWAY, Topology.TORUS, donate=True),
+        example_args=(g,), donated_argnums=(0,), mesh=m, out_spec=_SPEC)
+
+
+@register_builder("sharded.multi_step_dense", tags=("sharded", "dense"))
+def _contract_multi_step_dense():
+    m, g = _contract_example(packed=False)
+    return BuiltRunner(
+        lowerable=make_multi_step_dense(m, CONWAY, Topology.TORUS,
+                                        donate=True),
+        example_args=(g, 8), donated_argnums=(0,), mesh=m, out_spec=_SPEC)
+
+
+@register_builder("sharded.multi_step_packed_sparse",
+                  tags=("sharded", "packed", "sparse"))
+def _contract_multi_step_packed_sparse():
+    m, p = _contract_example()
+    return BuiltRunner(
+        lowerable=make_multi_step_packed_sparse(m, CONWAY, Topology.TORUS,
+                                                donate=True),
+        example_args=(p, initial_flags(m), 8), donated_argnums=(0, 1),
+        mesh=m, out_spec=_SPEC)
+
+
+@register_builder("sharded.multi_step_packed_deep",
+                  tags=("sharded", "packed", "comm-avoiding"))
+def _contract_multi_step_packed_deep():
+    g = 8
+    m, p = _contract_example()
+    return BuiltRunner(
+        lowerable=make_multi_step_packed_deep(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=g, donate=True),
+        example_args=(p, 1), donated_argnums=(0,), mesh=m, out_spec=_SPEC,
+        # the fori_loop body carries exactly one chunk exchange, so the
+        # whole program's collective bytes equal one exchange's model
+        expected_collective_bytes=deep_exchange_bytes(
+            p.shape, m, Topology.TORUS, g),
+        collective_model=f"deep_exchange_bytes(k={g})")
+
+
+@register_builder("sharded.multi_step_packed_ghost",
+                  tags=("sharded", "packed", "comm-avoiding"))
+def _contract_multi_step_packed_ghost():
+    k = 4
+    m, p = _contract_example()
+    return BuiltRunner(
+        # unroll_chunks=1: the prologue is the program's only exchange
+        # (the final block computes straight out of its halos), so the
+        # byte model covers the whole HLO
+        lowerable=make_multi_step_packed_ghost(
+            m, CONWAY, Topology.TORUS, gens_per_exchange=k, donate=True,
+            unroll_chunks=1),
+        example_args=(p,), donated_argnums=(0,), mesh=m, out_spec=_SPEC,
+        expected_collective_bytes=ghost_exchange_bytes(
+            p.shape, m, Topology.TORUS, k),
+        collective_model=f"ghost_exchange_bytes(k={k})")
+
+
+@register_builder("sharded.multi_step_banded", tags=("sharded", "packed"))
+def _contract_multi_step_banded():
+    m, p = _contract_example(banded=True)
+    # band out_spec depends on the mesh's flattened axis: no injection
+    # seam, the pinned-count contract still applies
+    return BuiltRunner(
+        lowerable=make_multi_step_banded(m, CONWAY, Topology.TORUS,
+                                         donate=True),
+        example_args=(p, 8), donated_argnums=(0,), mesh=m)

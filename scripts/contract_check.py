@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""CI gate: prove runner invariants from compiled HLO on the CPU platform.
+
+Enumerates the builder registry (ops/_jit.py BUILDERS), lowers every
+registered runner on an 8-virtual-device CPU jax, and checks the
+contracts analysis/contracts.py defines: donation really applied, zero
+host transfers, collective-permute bytes equal to the closed-form halo
+models, and count/byte totals matching the frozen manifest
+(results/hlo_contracts.json). Failures name the runner.
+
+Usage:
+    python scripts/contract_check.py                 # gate vs the manifest
+    python scripts/contract_check.py --strict        # CI: unpinned = fail
+    python scripts/contract_check.py --write         # regenerate manifest
+    python scripts/contract_check.py --only NAME     # one runner (fast)
+    python scripts/contract_check.py --json OUT      # machine-readable
+
+Exit codes (scripts/perf_gate.py contract): 0 = ok or skipped (stale
+manifest: pinned under a different jax version — invariants still
+enforced), 1 = contract violation, 2 = unusable input (missing manifest
+in --strict, unknown --only name).
+
+GOLTPU_CONTRACT_INJECT=<runner> routes that runner through a fault-
+injection seam that adds one ppermute to its program — the committed
+proof (tests/test_contracts.py) that this gate fails closed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+import axon_guard  # noqa: E402  (repo-root helper; must not import jax)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="HLO contract gate over the runner-builder registry")
+    ap.add_argument("--manifest",
+                    default=os.path.join(_REPO, "results",
+                                         "hlo_contracts.json"),
+                    help="frozen manifest path (default: "
+                         "results/hlo_contracts.json)")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the manifest from this run's "
+                         "measurements instead of gating against it")
+    ap.add_argument("--strict", action="store_true",
+                    help="CI mode: a runner the manifest does not pin, "
+                         "or a missing manifest, is a failure")
+    ap.add_argument("--only", action="append", metavar="NAME",
+                    help="check only this runner (repeatable)")
+    ap.add_argument("--json", dest="json_out", metavar="OUT",
+                    help="also write results as JSON to OUT")
+    args = ap.parse_args(argv)
+
+    # CPU staging BEFORE any package import: the package __init__ pulls
+    # in jax, and the contract platform must be 8 virtual CPU devices
+    axon_guard.force_cpu(8)
+    from gameoflifewithactors_tpu.analysis import contracts
+
+    inject = os.environ.get(contracts.ENV_INJECT) or None
+    try:
+        results = contracts.check_all(only=args.only, inject=inject)
+    except KeyError as e:
+        print(f"contract-check: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.json_out:
+        payload = {
+            "jax": contracts.jax_version(),
+            "results": [dataclasses.asdict(r) for r in results],
+        }
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.write:
+        manifest = contracts.build_manifest(results)
+        contracts.write_manifest(manifest, args.manifest)
+        print(f"contract-check: wrote {len(results)} runner contract(s) "
+              f"to {args.manifest} (jax {contracts.jax_version()})")
+        # still surface invariant violations: a manifest regenerated on
+        # top of a broken runner must not launder the breakage into a pin
+        bad = [e for r in results for e in r.errors]
+        for e in bad:
+            print(f"FAIL {e}")
+        return 1 if bad else 0
+
+    frozen = contracts.load_manifest(args.manifest)
+    if frozen is None and args.strict:
+        print(f"contract-check: no manifest at {args.manifest} — "
+              "generate one with --write and commit it", file=sys.stderr)
+        return 2
+
+    lines = contracts.gate(results, frozen, strict=args.strict,
+                           complete=not args.only)
+    for line in lines:
+        print(line)
+    failed = sum(1 for l in lines if l.startswith("FAIL "))
+    checked = len(results)
+    print(f"contract-check: {checked} runner(s), {failed} failure(s)"
+          + (" [strict]" if args.strict else ""))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,15 +56,50 @@ lint_lib = _load_lint_lib()
 DEFAULT_BASELINE = os.path.join(_REPO, "lint_baseline.json")
 
 
+def _sarif_payload(result) -> dict:
+    """Minimal SARIF 2.1.0 document: one run, the full rule registry in
+    the tool descriptor, one result per unsuppressed finding. Stays
+    stdlib-only like everything else in this file — the CI lint job
+    uploads this with no jax (and no SARIF library) installed."""
+    registry = dict(lint_lib.RULES)
+    registry.update(getattr(lint_lib, "PROJECT_RULES", {}))
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "goltpu-lint",
+                "informationUri":
+                    "https://github.com/gameoflifewithactors_tpu"
+                    "#static-analysis--sanitizers",
+                "rules": [
+                    {"id": code, "name": rule.name,
+                     "shortDescription": {"text": rule.summary}}
+                    for code, rule in sorted(registry.items())],
+            }},
+            "results": [
+                {"ruleId": f.code, "level": "error",
+                 "message": {"text": f.message},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {
+                         "uri": f.path.replace(os.sep, "/")},
+                     "region": {"startLine": f.line,
+                                "startColumn": f.col + 1}}}]}
+                for f in result.findings],
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="goltpu-lint",
-        description="TPU-invariant static analysis (rules GOL001…GOL006; "
+        description="TPU-invariant static analysis (rules GOL001…GOL010; "
                     "see README 'Static analysis & sanitizers')")
     ap.add_argument("paths", nargs="*",
-                    default=["gameoflifewithactors_tpu", "scripts"],
-                    help="files/directories to lint (default: the package "
-                         "and scripts/)")
+                    default=["gameoflifewithactors_tpu", "scripts",
+                             "tests", "examples"],
+                    help="files/directories to lint (default: the package, "
+                         "scripts/, tests/ and examples/)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="grandfathered-findings file (default: "
                          "lint_baseline.json at the repo root when it "
@@ -78,13 +113,20 @@ def main(argv=None) -> int:
     ap.add_argument("--strict-baseline", action="store_true",
                     help="stale (unmatched) baseline entries fail the run "
                          "instead of warning")
+    ap.add_argument("--sarif", metavar="OUT.json", default=None,
+                    help="additionally write findings as SARIF 2.1.0 to "
+                         "this path (CI code-scanning artifact)")
     args = ap.parse_args(argv)
 
     baseline_path = args.baseline
     if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
         baseline_path = DEFAULT_BASELINE
     baseline = None
-    if baseline_path and baseline_path != "none":
+    if (baseline_path and baseline_path != "none"
+            # --write-baseline creates the file: a missing target is the
+            # expected first-run state, not unusable input
+            and not (args.write_baseline
+                     and not os.path.exists(baseline_path))):
         try:
             baseline = lint_lib.load_baseline(baseline_path)
         except (OSError, json.JSONDecodeError,
@@ -103,6 +145,11 @@ def main(argv=None) -> int:
         paths.append(p)
 
     result = lint_lib.lint_paths(paths, baseline=baseline)
+
+    if args.sarif:
+        with open(args.sarif, "w") as f:
+            json.dump(_sarif_payload(result), f, indent=1)
+            f.write("\n")
 
     if args.write_baseline:
         payload = lint_lib.baseline_payload(

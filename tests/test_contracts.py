@@ -1,0 +1,167 @@
+"""The HLO contract gate (analysis/contracts.py + scripts/contract_check.py).
+
+Three layers, cheapest first: registry enumeration (imports only), gate
+semantics on fabricated results (pure functions — staleness, strict
+mode, lost registrations), and the fails-closed pin — an injected extra
+collective_permute must turn the gate red *naming the runner*. The
+full-manifest strict run is tier-2 (slow): it lowers and compiles all
+twelve runners.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gameoflifewithactors_tpu.analysis import contracts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, contracts.MANIFEST_RELPATH)
+
+GHOST = "sharded.multi_step_packed_ghost"
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_registry_enumerates_every_runner_family():
+    reg = contracts.load_registry()
+    assert len(reg) >= 10, sorted(reg)
+    for name, spec in reg.items():
+        assert spec.name == name
+        assert callable(spec.factory)
+    # every subsystem with a runner shows up — ops, sharded, batched
+    prefixes = {n.split(".")[0] for n in reg}
+    assert {"ops", "sharded", "batched"} <= prefixes
+
+
+def test_registry_refuses_duplicate_names():
+    from gameoflifewithactors_tpu.ops._jit import register_builder
+
+    with pytest.raises(ValueError, match=GHOST):
+        register_builder(GHOST, lambda: None)
+
+
+def test_check_all_rejects_unknown_only():
+    with pytest.raises(KeyError, match="no_such_runner"):
+        contracts.check_all(only=["no_such_runner"])
+
+
+# -- manifest + gate semantics (pure, no lowering) ----------------------------
+
+
+def _result(name="sharded.fake", *, count=4, nbytes=1536, errors=()):
+    return contracts.RunnerContracts(
+        name=name, tags=("sharded",), donated_argnums=(0,),
+        donation_applied=True, host_transfer_sites=[],
+        collective_permute_count=count, collective_permute_bytes=nbytes,
+        expected_collective_bytes=None, collective_model="",
+        errors=list(errors))
+
+
+def _fresh_manifest(results):
+    return contracts.build_manifest(results)
+
+
+def test_gate_ok_against_fresh_manifest():
+    r = _result()
+    lines = contracts.gate([r], _fresh_manifest([r]), strict=True)
+    assert lines == [f"ok {r.name} (count=4 bytes=1536)"]
+
+
+def test_gate_fails_on_pinned_count_drift():
+    r = _result(count=5)
+    frozen = _fresh_manifest([_result(count=4)])
+    lines = contracts.gate([r], frozen, strict=True)
+    assert len(lines) == 1 and lines[0].startswith(f"FAIL {r.name}:")
+    assert "count 5 != pinned 4" in lines[0]
+
+
+def test_gate_stale_jax_skips_never_oks():
+    r = _result()
+    frozen = _fresh_manifest([r])
+    frozen["jax"] = "0.0.0-elsewhere"
+    lines = contracts.gate([r], frozen, strict=True)
+    assert lines[0].startswith(f"skipped (stale) {r.name}")
+    assert not any(l.startswith("ok ") for l in lines)
+
+
+def test_gate_stale_jax_still_enforces_invariants():
+    r = _result(errors=["sharded.fake: host transfer(s) in compiled HLO"])
+    frozen = _fresh_manifest([_result()])
+    frozen["jax"] = "0.0.0-elsewhere"
+    lines = contracts.gate([r], frozen, strict=True)
+    assert lines[0].startswith("FAIL sharded.fake:")
+
+
+def test_gate_strict_fails_unpinned_runner():
+    r = _result()
+    lines = contracts.gate([r], _fresh_manifest([]), strict=True)
+    assert lines[0].startswith(f"FAIL {r.name}: not pinned")
+    loose = contracts.gate([r], _fresh_manifest([]), strict=False)
+    assert loose[0].startswith(f"unpinned {r.name}")
+
+
+def test_gate_fails_on_pinned_but_unregistered_runner():
+    frozen = _fresh_manifest([_result("sharded.gone")])
+    lines = contracts.gate([], frozen, strict=True)
+    assert lines == ["FAIL sharded.gone: pinned in the manifest but no "
+                     "longer registered — if the runner was removed on "
+                     "purpose, regenerate the manifest with --write"]
+    # --only runs check a subset: absence there is not a lost contract
+    assert contracts.gate([], frozen, strict=True, complete=False) == []
+
+
+def test_committed_manifest_pins_all_registered_runners():
+    frozen = contracts.load_manifest(MANIFEST)
+    assert frozen is not None, "results/hlo_contracts.json must be committed"
+    reg = contracts.load_registry()
+    assert set(frozen["runners"]) == set(reg)
+    for name, entry in frozen["runners"].items():
+        assert entry["host_transfer_sites"] == 0, name
+        if entry["donated_argnums"]:
+            assert entry["donation_applied"], name
+    # the comm-avoiding runners pin their closed-form byte models
+    deep = frozen["runners"]["sharded.multi_step_packed_deep"]
+    ghost = frozen["runners"][GHOST]
+    for entry in (deep, ghost):
+        assert entry["expected_collective_bytes"] == \
+            entry["collective_permute_bytes"]
+        assert "exchange_bytes" in entry["collective_model"]
+
+
+# -- fails-closed: the injection seam -----------------------------------------
+
+
+def _run_contract_check(args, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "contract_check.py"),
+         *args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+
+
+def test_injected_collective_fails_the_gate_naming_the_runner():
+    proc = _run_contract_check(["--only", GHOST],
+                               env_extra={contracts.ENV_INJECT: GHOST})
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 1, out
+    fails = [l for l in out.splitlines() if l.startswith("FAIL ")]
+    assert len(fails) == 1, out  # donation survives: ONE contract trips
+    assert fails[0].startswith(f"FAIL {GHOST}:")
+    assert "collective-permute bytes" in fails[0]
+
+
+@pytest.mark.slow
+def test_strict_gate_green_against_committed_manifest(tmp_path):
+    out_json = tmp_path / "contract_results.json"
+    proc = _run_contract_check(["--strict", "--json", str(out_json)])
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "0 failure(s)" in proc.stdout
+    doc = json.loads(out_json.read_text())
+    assert len(doc["results"]) >= 10
+    assert all(not r["errors"] for r in doc["results"])

@@ -83,3 +83,74 @@ def test_step_packed_donation_contract():
     b = step_packed(p, rule=CONWAY, topology=Topology.DEAD)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     bitpack.unpack(a)  # outputs stay live either way
+
+
+# -- the PR 11 use-after-free, pinned as a lint fixture -----------------------
+#
+# The runtime tests above can't catch the original bug on CPU (donation
+# is a no-op there). GOL008 catches it at review time instead; these
+# fixtures pin that the committed buggy shape is flagged and that the
+# shipped fix — jnp.array(x, copy=True) — comes back clean.
+
+import textwrap
+
+from gameoflifewithactors_tpu.analysis.lint import lint_source
+
+_PR11_BUG = textwrap.dedent("""
+    import jax.numpy as jnp
+
+    from gameoflifewithactors_tpu.parallel import sharded
+
+
+    def soak(mesh, caller_grid, gens):
+        run = sharded.make_multi_step_packed(mesh, "conway", donate=True)
+        p = jnp.asarray(caller_grid)
+        out = run(p, gens)
+        return out, caller_grid.sum()
+""")
+
+_PR11_FIX = _PR11_BUG.replace("jnp.asarray(caller_grid)",
+                              "jnp.array(caller_grid, copy=True)")
+
+
+def test_gol008_flags_the_pr11_donated_alias():
+    findings = [f for f in lint_source(_PR11_BUG, "examples/soak.py").findings
+                if f.code == "GOL008"]
+    assert findings, "the PR 11 alias-into-donated-call shape must flag"
+    assert any("caller_grid" in f.message and "use-after-free" in f.message
+               for f in findings)
+
+
+def test_gol008_clean_on_the_shipped_copy_fix():
+    rep = lint_source(_PR11_FIX, "examples/soak.py")
+    assert [f for f in rep.findings if f.code == "GOL008"] == []
+
+
+def test_gol008_flags_read_after_donation_without_rebind():
+    src = textwrap.dedent("""
+        from gameoflifewithactors_tpu.parallel import sharded
+
+
+        def drive(mesh, p, gens):
+            run = sharded.make_multi_step_packed(mesh, "conway", donate=True)
+            out = run(p, gens)
+            return out, p.sum()
+    """)
+    msgs = [f.message for f in lint_source(src, "examples/drive.py").findings
+            if f.code == "GOL008"]
+    assert any("read after being donated" in m for m in msgs), msgs
+
+
+def test_gol008_clean_on_rebind_after_donate():
+    src = textwrap.dedent("""
+        from gameoflifewithactors_tpu.parallel import sharded
+
+
+        def drive(mesh, p, gens):
+            run = sharded.make_multi_step_packed(mesh, "conway", donate=True)
+            for _ in range(gens):
+                p = run(p, 1)
+            return p
+    """)
+    rep = lint_source(src, "examples/drive.py")
+    assert [f for f in rep.findings if f.code == "GOL008"] == []

@@ -174,9 +174,9 @@ def test_cli_sigterm_leaves_flight_dump(tmp_path):
     try:
         # --metrics jsonl streams a record per tick to stderr: the first
         # one proves the run is stepping (past construction + compile)
-        deadline = time.time() + 120
+        deadline = time.monotonic() + 120
         for line in p.stderr:
-            if '"generation"' in line or time.time() > deadline:
+            if '"generation"' in line or time.monotonic() > deadline:
                 break
         p.send_signal(signal.SIGTERM)
         rc = p.wait(timeout=60)
