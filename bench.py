@@ -71,7 +71,8 @@ def _parse(argv):
                     help="generations per timed repetition (default: autotuned)")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--backend",
-                    choices=["auto", "packed", "dense", "pallas", "sparse"],
+                    choices=["auto", "packed", "dense", "pallas", "sparse",
+                             "paged"],
                     default="auto",
                     help="auto = native pallas kernel on TPU when the shape "
                          "supports it (fastest), XLA packed otherwise")
@@ -372,7 +373,7 @@ def run_bench(args) -> None:
                             planes=n_planes(rule.states)))
         if not ok:
             _route_rule(True, "bit-plane packed")
-    elif isinstance(rule, GenRule) and args.backend != "dense":
+    elif isinstance(rule, GenRule) and args.backend not in ("dense", "paged"):
         # multi-state rules have a bit-plane packed path (~4x the dense
         # rate on CPU) when the width packs (32 cells/word)
         _route_rule(True, "bit-plane packed")
@@ -388,7 +389,8 @@ def run_bench(args) -> None:
         if not ok:
             _route_rule(platform == "tpu" and rule.states == 2,
                         "bit-sliced packed")
-    elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse"):
+    elif isinstance(rule, LtLRule) and args.backend not in ("dense", "sparse",
+                                                            "paged"):
         # LtL: bit-sliced packed path (binary) / bit-plane stack (C >= 3
         # decay) on explicit request; on TPU auto, binary rides packed
         # (measured) while C >= 3 stays on the byte path until the plane
@@ -406,9 +408,10 @@ def run_bench(args) -> None:
         return int(jnp.sum(x.astype(jnp.uint32))) & 0xFFFF
 
     rng = np.random.default_rng(0)
-    if args.backend == "sparse":
+    if args.backend in ("sparse", "paged"):
         # config #5's shape: a Gosper gun in a huge empty field (a random
-        # soup would always take the dense fallback)
+        # soup would always take the dense fallback, and a paged pool
+        # would degenerate to fully dense)
         from gameoflifewithactors_tpu.models import seeds as seeds_lib
 
         grid = seeds_lib.seeded((side, side), "gosper_gun", side // 2, side // 2)
@@ -495,6 +498,22 @@ def run_bench(args) -> None:
             return sparse_state.packed
 
         state = sparse_state.packed
+    elif args.backend == "paged":
+        # page-table grids over the tile pool (memory/): footprint and
+        # compute scale with the gun's live region, measured on the same
+        # seed as sparse so the two activity-scaling backends compare
+        from gameoflifewithactors_tpu.memory import PagedEngineState
+
+        paged_state = PagedEngineState(
+            jnp.asarray(bitpack.pack_np(np.asarray(grid))), rule,
+            topology=Topology.TORUS)
+        paged_state.pool.warm()
+
+        def run(s, n):
+            paged_state.step(int(n))
+            return paged_state.packed
+
+        state = paged_state.packed
     elif isinstance(rule, GenRule):
         from gameoflifewithactors_tpu.ops.generations import multi_step_generations
 
@@ -549,7 +568,7 @@ def run_bench(args) -> None:
             # time to ~2% — re-size the remaining repetitions from it
             gens = max(10, min(16384, int(4.0 * gens / dt)))
 
-    seed_note = ("gosper-gun" if args.backend == "sparse"
+    seed_note = ("gosper-gun" if args.backend in ("sparse", "paged")
                  else "uniform state soup" if getattr(rule, "states", 2) > 2
                  else "50% soup")
     print(json.dumps({

@@ -64,6 +64,11 @@ _MAIN_SIG_RE = re.compile(r"func\.func public @main\((?P<sig>.*?)\)\s*->",
                           re.DOTALL)
 _ARG_SPLIT_RE = re.compile(r"%arg(\d+):")
 
+# gather instructions in compiled HLO (inside fusions too — compiled
+# text includes fusion bodies); the lookbehind keeps `all-gather`
+# collectives from counting as neighbor-resolution gathers
+_GATHER_RE = re.compile(r"(?<![\w-])gather\(")
+
 
 @dataclasses.dataclass
 class RunnerContracts:
@@ -77,7 +82,9 @@ class RunnerContracts:
     collective_permute_bytes: int
     expected_collective_bytes: Optional[int]
     collective_model: str
-    errors: List[str]
+    gather_count: int = 0
+    require_gather: bool = False
+    errors: List[str] = dataclasses.field(default_factory=list)
 
     def to_manifest_entry(self) -> dict:
         return {
@@ -89,6 +96,8 @@ class RunnerContracts:
             "collective_permute_bytes": self.collective_permute_bytes,
             "expected_collective_bytes": self.expected_collective_bytes,
             "collective_model": self.collective_model,
+            "gather_count": self.gather_count,
+            "require_gather": self.require_gather,
         }
 
 
@@ -96,7 +105,8 @@ def load_registry() -> Dict[str, object]:
     """Import every builder module so ``BUILDERS`` is fully populated,
     and return it. Importing is the whole registration protocol — the
     factories themselves stay unbuilt until the gate calls them."""
-    from ..ops import packed, stencil  # noqa: F401  (register on import)
+    from ..memory import pool  # noqa: F401  (register on import)
+    from ..ops import packed, stencil  # noqa: F401
     from ..parallel import batched, sharded  # noqa: F401
     from ..ops._jit import BUILDERS
 
@@ -210,6 +220,15 @@ def check_runner(spec, *, inject: bool = False) -> RunnerContracts:
             f"closed-form {built.expected_collective_bytes} "
             f"({built.collective_model or 'model'})")
 
+    gather_count = len(_GATHER_RE.findall(hlo))
+    require_gather = bool(getattr(built, "require_gather", False))
+    if require_gather and gather_count == 0:
+        errors.append(
+            f"{spec.name}: no gather ops in compiled HLO — the paged "
+            "runner's contract is page-table GATHER neighbor resolution "
+            "(slot indirection compiled away means halos stopped being "
+            "data-dependent, i.e. the page table is no longer consulted)")
+
     return RunnerContracts(
         name=spec.name, tags=tuple(spec.tags),
         donated_argnums=tuple(built.donated_argnums),
@@ -219,6 +238,8 @@ def check_runner(spec, *, inject: bool = False) -> RunnerContracts:
         collective_permute_bytes=cp_bytes,
         expected_collective_bytes=built.expected_collective_bytes,
         collective_model=built.collective_model,
+        gather_count=gather_count,
+        require_gather=require_gather,
         errors=errors)
 
 

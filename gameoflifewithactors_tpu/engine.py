@@ -37,7 +37,7 @@ from .ops.stencil import Topology, multi_step
 from .parallel import mesh as mesh_lib
 from .parallel import sharded
 
-BACKENDS = ("packed", "dense", "pallas", "sparse")
+BACKENDS = ("packed", "dense", "pallas", "sparse", "paged")
 
 
 @lru_cache(maxsize=1)
@@ -110,7 +110,11 @@ class Engine:
         3x3 binary bitboards and, single-device, Generations bit-plane
         stacks; both topologies on one device — torus refreshes the halo
         ring with wrapped edges each generation — and with a mesh the
-        binary form shards with per-device activity skipping).
+        binary form shards with per-device activity skipping), or
+        "paged" (page-table grids over a fixed tile pool, memory/ —
+        tiles exist only where live structure does, so footprint scales
+        with activity, not area; single device, both topologies, any
+        rule without birth-from-nothing).
     gens_per_exchange: sharded packed and pallas backends — G > 1
         exchanges a depth-G halo once per G generations
         (communication-avoiding) instead of a 1-deep halo every
@@ -202,20 +206,22 @@ class Engine:
         # neighborhoods — the diamond sum is per-row separable,
         # ops/packed_ltl.py; multi-state C>=3 decays on the byte path)
         self._ltl_packed = (self._ltl
-                            and backend in ("packed", "sparse", "pallas")
+                            and backend in ("packed", "sparse", "pallas",
+                                            "paged")
                             and _packs and self.rule.states == 2)
         # multi-state (C >= 3) LtL: bit-plane stack (the Generations
         # layout driven by radius-r interval counts, ops/packed_ltl.py
         # step_ltl_planes) — the packed/sparse face of the decay family
         # the dense byte path serves
         self._ltl_planes = (self._ltl and self.rule.states >= 3
-                            and backend in ("packed", "sparse") and _packs)
-        if self._ltl and backend == "sparse" and not (
+                            and backend in ("packed", "sparse", "paged")
+                            and _packs)
+        if self._ltl and backend in ("sparse", "paged") and not (
                 self._ltl_packed or self._ltl_planes):
-            # an explicit sparse request that sparse cannot serve must not
-            # silently become a dense run
+            # an explicit sparse/paged request that the packed layouts
+            # cannot serve must not silently become a dense run
             raise ValueError(
-                f"sparse LtL needs a width divisible by "
+                f"{backend} LtL needs a width divisible by "
                 f"{bitpack.WORD * _pack_cols} (32-cell words must shard "
                 f"whole over {_ny} mesh column(s)), got "
                 f"{self.rule.notation} on {self.shape}; use backend='dense'")
@@ -244,7 +250,7 @@ class Engine:
                     stacklevel=3,
                 )
             self.backend = backend = "dense"
-        self._packed = (backend in ("packed", "pallas", "sparse")
+        self._packed = (backend in ("packed", "pallas", "sparse", "paged")
                         and not (self._generations or self._ltl)
                         ) or self._ltl_packed
         # Generations with the packed backend: bit-plane stack
@@ -253,13 +259,15 @@ class Engine:
         # Multi-state LtL shares the layout (and thus the pack/unpack/
         # population/checkpoint machinery) — only the stepper differs.
         self._gen_packed = (self._generations
-                            and backend in ("packed", "pallas", "sparse")
+                            and backend in ("packed", "pallas", "sparse",
+                                            "paged")
                             and _packs) or self._ltl_planes
-        if self._generations and backend == "sparse" and not self._gen_packed:
-            # the sparse engine's Generations layout IS the plane stack;
-            # there is no byte-layout sparse path to fall back to
+        if self._generations and backend in ("sparse", "paged") \
+                and not self._gen_packed:
+            # the sparse/paged engines' Generations layout IS the plane
+            # stack; there is no byte-layout path to fall back to
             raise ValueError(
-                f"the sparse backend stores Generations universes as "
+                f"the {backend} backend stores Generations universes as "
                 f"bit-plane stacks: width {self.shape[1]} must shard into "
                 f"whole 32-cell words over {_ny} mesh column(s) "
                 f"(divisible by {32 * _ny})")
@@ -287,6 +295,11 @@ class Engine:
         self._flags = None
         self._sparse_tiles = None
         self._ghost_pipeline = False  # width-g overlapped pipeline in use
+        if mesh is not None and backend == "paged":
+            raise ValueError(
+                "the paged backend is single-device (its page tables are "
+                "host bookkeeping over one pool slab); use backend="
+                "'sparse' for sharded activity skipping")
         if mesh is not None:
             # validate in *cell* units before packing, so the error names the
             # user's grid shape, not the packed word shape
@@ -498,6 +511,18 @@ class Engine:
                 state, self.rule, topology=topology, **opts)
             self._run = None  # step() routes through the sparse state
             state = None  # the padded copy inside _sparse is the state now
+        elif backend == "paged":
+            from .memory import PagedEngineState
+
+            # sparse_opts carries the slab geometry here too — the paged
+            # engine is the sparse engine's pool-allocated sibling, and
+            # the keys (tile_rows/tile_words/capacity) mean the same
+            # thing; PagedEngineState validates divisibility itself
+            self._sparse = PagedEngineState(
+                state, self.rule, topology=topology,
+                **dict(sparse_opts or {}))
+            self._run = None  # step() routes through the paged state
+            state = None  # the pool slab holds the live tiles now
         elif backend == "pallas" and self._ltl:
             # radius-r temporal-blocked kernel (native on TPU, interpret
             # elsewhere); unsupported shapes fall back to the bit-sliced

@@ -1,0 +1,34 @@
+"""Paged tile-pool grid memory (ROADMAP item 3).
+
+The paged-KV-cache idea applied to CA grids: a fixed slab of physical
+tiles (:class:`TilePool`) backs any number of logical universes
+(:class:`PagedGrid`) through per-session page tables. Missing pages alias
+one canonical dead tile, so a 4096² universe with 2% live cells costs a
+few dozen physical tiles instead of 4096 dense ones — and a universe with
+no bounds at all (:class:`PagedUniverse`) costs only its live front.
+
+Everything the pool steps goes through ONE warm executable
+(parallel/batched.make_multi_step_paged): geometry, topology, and
+occupancy are runtime operands (page table + mask), so page allocation,
+retirement, and tenants of different logical shapes never retrace.
+"""
+
+from .pool import DEAD_SLOT, PoolExhausted, TilePool
+from .paged import (
+    PagedEngineState,
+    PagedGrid,
+    PagedUniverse,
+    default_chunk_gens,
+    step_grids,
+)
+
+__all__ = [
+    "DEAD_SLOT",
+    "PoolExhausted",
+    "TilePool",
+    "PagedEngineState",
+    "PagedGrid",
+    "PagedUniverse",
+    "default_chunk_gens",
+    "step_grids",
+]

@@ -1,0 +1,255 @@
+"""The fixed-slab tile pool: B physical tiles, one device array, one
+free list, one warm executable.
+
+A :class:`TilePool` owns a ``(B, planes, tile_rows, tile_words)`` uint32
+slab (slab geometry = ops/sparse.py's Pallas-validated tile sizes, word
+layout = ops/bitpack.py) plus the host mirror of the on-device page
+tables: a ``(B, 8)`` int32 neighbor matrix in
+:data:`~gameoflifewithactors_tpu.parallel.batched.PAGED_NEIGHBORS` order.
+Slot :data:`DEAD_SLOT` is reserved as the canonical dead tile — every
+unallocated page of every tenant aliases it, which is what makes a
+sparse region cost *nothing* rather than one-tile-per-page.
+
+Invariants the allocator maintains:
+
+- free slots are all-zero ON DEVICE (zeroed at release, zeros at init),
+  so :meth:`alloc` is pure host bookkeeping — no device work, no
+  retrace, which is what lets the wake front of a glider allocate pages
+  mid-flight under ``retrace_budget(0)``;
+- slot surgery (seed writes, release zeroing) goes through module-level
+  tracked_jit kernels with *traced* slot indices, so a thousand
+  different slots share one compiled program;
+- pool exhaustion raises :class:`PoolExhausted` here and is a
+  *scheduling* event upstream (serve/admission.py queues or rejects;
+  the paged step loop excludes the starved grid) — never a crash of
+  co-tenants.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis import sanitizers as _sanitizers
+from ..obs.registry import REGISTRY, MetricsRegistry
+from ..ops import sparse as _sparse
+from ..ops._jit import BuiltRunner, register_builder, tracked_jit
+from ..parallel.batched import make_multi_step_paged
+
+DEAD_SLOT = 0
+
+
+class PoolExhausted(RuntimeError):
+    """The free list is empty. Catchers decide policy: serve queues the
+    session through admission, step_grids stalls the starved grid, the
+    Engine path (which sizes its private pool to the dense tile count)
+    never sees it."""
+
+
+# -- slot surgery -------------------------------------------------------------
+#
+# One compiled program per operation, slot index traced: writing slot 3
+# and slot 900 are the same executable. Donation is safe — the pool owns
+# its slab and rebinds it from each call's return.
+
+_DONATE_SURGERY = True
+
+
+@tracked_jit(runner="memory.pool_write_slot",
+             donate_argnums=(0,) if _DONATE_SURGERY else ())
+def _write_slot(tiles, slot, content):
+    return jax.lax.dynamic_update_index_in_dim(
+        tiles, content.astype(tiles.dtype), slot, 0)
+
+
+@tracked_jit(runner="memory.pool_zero_slot",
+             donate_argnums=(0,) if _DONATE_SURGERY else ())
+def _zero_slot(tiles, slot):
+    return jax.lax.dynamic_update_index_in_dim(
+        tiles, jnp.zeros(tiles.shape[1:], tiles.dtype), slot, 0)
+
+
+class TilePool:
+    """B physical tiles for one rule family, shared by any number of
+    logical grids (see memory/paged.py for the page-table layer)."""
+
+    def __init__(self, rule, capacity: int, *,
+                 tile_rows: Optional[int] = None,
+                 tile_words: Optional[int] = None,
+                 name: str = "pool",
+                 registry: MetricsRegistry = REGISTRY,
+                 donate: bool = True,
+                 runner=None):
+        from ..models.generations import parse_any
+
+        rule = parse_any(rule)
+        if _sparse.births_from_nothing(rule):
+            raise ValueError(
+                f"paged memory cannot serve birth-from-nothing rules "
+                f"({rule.notation}): the canonical dead tile would birth "
+                "cells, so 'missing page = dead' stops being a closure — "
+                "use the packed backend")
+        if capacity < 2:
+            raise ValueError(
+                f"pool capacity must be >= 2 (slot {DEAD_SLOT} is the "
+                f"reserved dead tile), got {capacity}")
+        self.rule = rule
+        self.capacity = int(capacity)
+        self.tile_rows = int(tile_rows or _sparse.DEFAULT_TILE_ROWS)
+        self.tile_words = int(tile_words or _sparse.DEFAULT_TILE_WORDS)
+        self.planes, _ = _sparse.rule_layout(rule)
+        self.name = name
+        self.tiles = jnp.zeros(
+            (self.capacity, self.planes, self.tile_rows, self.tile_words),
+            jnp.uint32)
+        # host mirror of the page tables; row DEAD_SLOT stays self-dead
+        self.neighbors = np.zeros((self.capacity, 8), np.int32)
+        self._free: List[int] = list(range(self.capacity - 1, 0, -1))
+        # pass a shared runner (serve/lanes.paged_lane_runner) so pools of
+        # one geometry share warm executables process-wide
+        self._runner = runner if runner is not None else make_multi_step_paged(
+            rule, self.tile_rows, self.tile_words, donate=donate)
+        self._in_use_g = registry.gauge(
+            "pool_tiles_in_use", "physical tiles allocated to pages")
+        self._free_g = registry.gauge(
+            "pool_tiles_free", "physical tiles on the free list")
+        self._alloc_c = registry.counter(
+            "pool_alloc_total", "page-to-tile allocations")
+        self._reclaim_c = registry.counter(
+            "pool_reclaim_total", "dead pages reclaimed to the free list")
+        self._oom_c = registry.counter(
+            "pool_oom_total", "allocations refused on an empty free list")
+        self._set_gauges()
+
+    # -- accounting -----------------------------------------------------------
+
+    def _set_gauges(self) -> None:
+        self._in_use_g.set(self.in_use(), pool=self.name)
+        self._free_g.set(self.free_count(), pool=self.name)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> int:
+        """Tiles bound to pages (the dead slot is neither free nor in use)."""
+        return self.capacity - 1 - len(self._free)
+
+    def tile_bytes(self) -> int:
+        return self.planes * self.tile_rows * self.tile_words * 4
+
+    def tile_cells(self) -> Tuple[int, int]:
+        """(rows, cols) of one tile in cell units."""
+        from ..ops import bitpack
+
+        return self.tile_rows, self.tile_words * bitpack.WORD
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "in_use": self.in_use(),
+            "free": self.free_count(),
+            "tile_bytes": self.tile_bytes(),
+            "planes": self.planes,
+            "tile_rows": self.tile_rows,
+            "tile_words": self.tile_words,
+        }
+
+    # -- allocator ------------------------------------------------------------
+
+    def alloc(self) -> int:
+        """Bind a free slot: host bookkeeping only — the slot is already
+        zero on device, so a page of empty space costs no device work."""
+        if not self._free:
+            self._oom_c.inc(pool=self.name)
+            raise PoolExhausted(
+                f"pool {self.name!r} exhausted: {self.capacity - 1} tiles "
+                "all bound")
+        slot = self._free.pop()
+        self._alloc_c.inc(pool=self.name)
+        self._set_gauges()
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the free list, re-establishing the
+        free-slots-are-zero invariant on device and severing its page-table
+        row. Callers (PagedGrid._unlink) sever the *incoming* edges."""
+        if slot == DEAD_SLOT:
+            raise ValueError("the dead slot is not allocatable or freeable")
+        self.tiles = _zero_slot(self.tiles, slot)
+        self.neighbors[slot] = DEAD_SLOT
+        self._free.append(slot)
+        self._reclaim_c.inc(pool=self.name)
+        self._set_gauges()
+
+    # -- slab access ----------------------------------------------------------
+
+    def write(self, slot: int, content: np.ndarray) -> None:
+        """Seed one tile's (planes, tile_rows, tile_words) words."""
+        self.tiles = _write_slot(self.tiles, slot,
+                                 jnp.asarray(content, jnp.uint32))
+
+    def tiles_host(self) -> np.ndarray:
+        """The whole slab on host — checkpoint/readback granularity; the
+        step path never calls this."""
+        with _sanitizers.allow_host_transfers(
+                "pool slab readback: checkpoint/snapshot reconstruction "
+                "is host-side by design"):
+            return np.asarray(self.tiles)
+
+    # -- stepping -------------------------------------------------------------
+
+    def dispatch(self, n: int, mask: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Advance every masked slot ``n`` generations through the one
+        warm executable; returns host (changed, occupied) bool vectors —
+        the per-chunk wake/retire evidence (the paged analogue of the
+        sparse engine's generations-completed scalar)."""
+        self.tiles, changed, occupied = self._runner(
+            self.tiles, int(n), jnp.asarray(self.neighbors),
+            jnp.asarray(mask, dtype=jnp.uint32))
+        with _sanitizers.allow_host_transfers(
+                "paged pool reads per-slot changed/occupied flags between "
+                "chunks — page activation/retirement is host bookkeeping"):
+            return np.asarray(changed), np.asarray(occupied)
+
+    def warm(self) -> None:
+        """Compile every program the pool will ever run — the step
+        executable (one all-dead-mask dispatch at the pool's only shape)
+        and the slot-surgery pair (no-op writes on a free slot, which is
+        zero and stays zero) — so allocation churn after warm is pure
+        host bookkeeping under ``retrace_budget(0)``."""
+        self.dispatch(1, np.zeros((self.capacity,), np.uint32))
+        if self._free:
+            spare = self._free[-1]
+            self.tiles = _write_slot(
+                self.tiles, spare,
+                jnp.zeros(self.tiles.shape[1:], jnp.uint32))
+            self.tiles = _zero_slot(self.tiles, spare)
+
+
+# -- contract-gate registrations (ops/_jit.py BUILDERS) -----------------------
+
+
+def _contract_pool_slab(B=16, planes=1, tr=32, tw=4, seed=3):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, 1 << 32, size=(B, planes, tr, tw), dtype=np.uint64)
+        .astype(np.uint32))
+
+
+@register_builder("memory.pool_write_slot", tags=("memory", "paged"))
+def _contract_pool_write_slot():
+    tiles = _contract_pool_slab()
+    content = jnp.ones(tiles.shape[1:], jnp.uint32)
+    return BuiltRunner(lowerable=_write_slot, example_args=(tiles, 3, content),
+                       donated_argnums=(0,))
+
+
+@register_builder("memory.pool_zero_slot", tags=("memory", "paged"))
+def _contract_pool_zero_slot():
+    tiles = _contract_pool_slab()
+    return BuiltRunner(lowerable=_zero_slot, example_args=(tiles, 3),
+                       donated_argnums=(0,))

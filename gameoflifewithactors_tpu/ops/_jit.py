@@ -136,6 +136,12 @@ class BuiltRunner:
     ``mesh``/``out_spec`` let the gate's fault-injection seam wrap the
     runner with one extra ppermute (GOLTPU_CONTRACT_INJECT) to prove the
     gate actually fails closed; single-device runners leave them None.
+
+    ``require_gather`` makes the gate insist the compiled HLO resolves
+    neighbors by gather (≥1 gather/dynamic-gather op). The paged pool
+    runner sets it: its whole point is that halos come from page-table
+    *indexing*, not per-slot copies, and a refactor that silently turned
+    the gather into unrolled copies would retrace on every allocation.
     """
     lowerable: Callable
     example_args: tuple
@@ -145,6 +151,7 @@ class BuiltRunner:
     collective_model: str = ""
     mesh: Optional[object] = None
     out_spec: Optional[object] = None
+    require_gather: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

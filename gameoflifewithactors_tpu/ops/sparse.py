@@ -673,3 +673,64 @@ class SparseEngineState:
 
     def active_tiles(self) -> int:
         return int(jnp.sum(self.active))
+
+
+# -- the paged-memory face -----------------------------------------------------
+#
+# memory/ (the paged tile-pool subsystem) drives page activation and
+# retirement with the same changed-last-generation machinery this module
+# uses for tile wake tracking. These public aliases plus the host-side
+# coordinate dilation are that shared face: ONE definition of a rule's
+# halo depth, its packed layout, and "how far can influence travel per
+# chunk" for both consumers — the activity-map engine here and the
+# page-table allocator there cannot drift on soundness-critical radii.
+
+rule_halo = _rule_halo
+wake_dilation = _wake_dilation
+births_from_nothing = _births_from_nothing
+
+
+def rule_layout(rule) -> Tuple[int, int]:
+    """``(planes, window_ndim)`` of a rule's packed layout: binary
+    life-like families and 2-state LtL run 2D bitboards ``(1, 2)``;
+    Generations and C >= 3 LtL run ``(b, H, W/32)`` bit-plane stacks
+    ``(n_planes(states), 3)``. The paged tile pool sizes its slab's
+    leading plane axis from this, and the paged runner picks the matching
+    :func:`_step_fns` variant — the same selection the sparse window
+    steppers make from their operand's ndim."""
+    from ..models.ltl import LtLRule
+    from .packed_generations import n_planes
+
+    if isinstance(rule, LtLRule):
+        if rule.states == 2:
+            return 1, 2
+        return n_planes(rule.states), 3
+    if isinstance(rule, Rule):
+        return 1, 2
+    return n_planes(rule.states), 3  # GenRule plane stack
+
+
+def dilate_coords(coords, dy: int = 1, dx: int = 1, *, bounds=None,
+                  wrap: bool = False):
+    """Host-side tile-coordinate dilation: every (ty, tx) within a
+    (2dy+1) x (2dx+1) tile neighborhood of the input set — exactly
+    :func:`_dilate` lifted from a dense activity map to a sparse
+    coordinate set, which is the form the paged page table needs (an
+    unbounded universe has no dense map to dilate). ``bounds`` =
+    (nty, ntx) clips out-of-range coords (the DEAD closure) or wraps
+    them when ``wrap`` is set (TORUS: an edge page's change wakes the
+    opposite-edge page); ``bounds=None`` is the unbounded plane, where
+    every neighbor coordinate exists."""
+    out = set()
+    for ty, tx in coords:
+        for oy in range(-dy, dy + 1):
+            for ox in range(-dx, dx + 1):
+                y, x = ty + oy, tx + ox
+                if bounds is not None:
+                    nty, ntx = bounds
+                    if wrap:
+                        y, x = y % nty, x % ntx
+                    elif not (0 <= y < nty and 0 <= x < ntx):
+                        continue
+                out.add((y, x))
+    return out

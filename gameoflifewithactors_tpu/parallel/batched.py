@@ -105,6 +105,101 @@ def make_multi_step_packed_batched(
                        donate_argnums=(0,) if donate else ())
 
 
+# the paged runner's neighbor-gather order: the 8 tile neighbors of a
+# pool slot, row-major. OPPOSITE[i] == 7 - i (the reciprocal direction) —
+# the page-table maintenance in memory/paged.py leans on that symmetry
+# when it back-links a freshly allocated page into its neighbors' rows.
+PAGED_NEIGHBORS = ((-1, -1), (-1, 0), (-1, 1), (0, -1),
+                   (0, 1), (1, -1), (1, 0), (1, 1))
+
+
+def make_multi_step_paged(
+    rule, tile_rows: Optional[int] = None, tile_words: Optional[int] = None,
+    *, donate: bool = True,
+) -> Callable:
+    """The paged tile-pool runner: jitted ``(tiles, n, neighbors, mask)
+    -> (tiles, changed, occupied)`` stepping ONE batch of physical tiles
+    per generation regardless of which logical session owns them.
+
+    - ``tiles`` is the pool's (B, planes, tile_rows, tile_words) uint32
+      slab (memory/pool.py; planes = ops.sparse.rule_layout(rule)[0]).
+      Slot 0 is the canonical dead tile: all-zero, never masked live.
+    - ``neighbors`` is the on-device face of the page tables: (B, 8)
+      int32 slot ids in :data:`PAGED_NEIGHBORS` order. Halos are
+      resolved by *gathering* the 8 neighbor tiles' edge strips —
+      missing pages point at slot 0, whose zero content IS the DEAD
+      closure, and TORUS sessions simply wrap their coordinates when
+      building the table, so topology (and universe bounds, including
+      "none") is runtime data: one executable serves every geometry.
+    - ``mask`` is the (B,) uint32 occupancy vector of
+      :func:`make_multi_step_packed_batched` — slots not being stepped
+      (free, dead, or owned by a session with no debt) pass through
+      bit-identical, so page allocation/retirement never retraces.
+
+    Returns the advanced pool plus two (B,) bool vectors: ``changed``
+    (slot differed from its input in ANY generation — the
+    changed-last-chunk wake flag that drives page activation) and
+    ``occupied`` (slot holds any live bit at exit — all-dead AND
+    unchanged pages outside the wake ring are reclaimable). The caller
+    reads both back between chunks; that one small fetch is the paged
+    analogue of the sparse engine's generations-completed scalar.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import sparse as _sp
+
+    tile_rows = int(tile_rows or _sp.DEFAULT_TILE_ROWS)
+    tile_words = int(tile_words or _sp.DEFAULT_TILE_WORDS)
+    planes, ndim = _sp.rule_layout(rule)
+    r, rw = _sp.rule_halo(rule)
+    if r > tile_rows or rw > tile_words:
+        raise ValueError(
+            f"rule halo ({r} rows, {rw} words) exceeds the slab geometry "
+            f"({tile_rows} rows, {tile_words} words): a neighbor gather "
+            "can reach one tile ring, no further — grow the slab")
+
+    ext_step = _sp._step_fns(rule, ndim)[0]
+
+    def _window(t, nbr):
+        # slice each direction's edge strip FIRST, then gather rows by
+        # neighbor slot: the gather moves (B, planes, r, ·) strips, not
+        # whole tiles
+        def take(i, strip):
+            return strip[nbr[:, i]]
+
+        top = jnp.concatenate(
+            [take(0, t[..., -r:, -rw:]), take(1, t[..., -r:, :]),
+             take(2, t[..., -r:, :rw])], axis=-1)
+        mid = jnp.concatenate(
+            [take(3, t[..., :, -rw:]), t, take(4, t[..., :, :rw])], axis=-1)
+        bot = jnp.concatenate(
+            [take(5, t[..., :r, -rw:]), take(6, t[..., :r, :]),
+             take(7, t[..., :r, :rw])], axis=-1)
+        return jnp.concatenate([top, mid, bot], axis=-2)
+
+    def _gen(t, nbr):
+        w = _window(t, nbr)
+        if ndim == 2:
+            return jax.vmap(ext_step)(w[:, 0])[:, None]
+        return jax.vmap(ext_step)(w)
+
+    def _run(tiles, n, neighbors, mask):
+        live = (mask != 0)[:, None, None, None]
+
+        def body(_, carry):
+            t, ch = carry
+            out = jnp.where(live, _gen(t, neighbors), t)
+            return out, ch | (out != t).any(axis=(1, 2, 3))
+
+        changed0 = jnp.zeros((tiles.shape[0],), bool)
+        tiles, changed = jax.lax.fori_loop(0, n, body, (tiles, changed0))
+        occupied = (tiles != 0).any(axis=(1, 2, 3))
+        return tiles, changed, occupied
+
+    return tracked_jit(_run, runner="batched.multi_step_paged",
+                       donate_argnums=(0,) if donate else ())
+
+
 def make_multi_step_pallas_batched(
     mesh: Mesh, rule: Rule, topology: Topology = Topology.TORUS,
     gens_per_exchange: int = 8,
@@ -227,3 +322,24 @@ def _contract_multi_step_packed_batched_masked():
             m, CONWAY, Topology.TORUS, donate=True, masked=True),
         example_args=(grids, 8, mask), donated_argnums=(0,), mesh=m,
         out_spec=_SPEC)
+
+
+@register_builder("batched.multi_step_paged",
+                  tags=("batched", "paged", "serving"))
+def _contract_multi_step_paged():
+    import jax.numpy as jnp
+
+    # a 64-slot pool of Conway tiles with a scrambled page table: the
+    # contract is about the runner's shape (donated slab, gathered halos,
+    # no host round-trips), not about any particular universe
+    B, tr, tw = 64, 32, 4
+    rng = np.random.default_rng(11)
+    tiles = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(B, 1, tr, tw), dtype=np.uint64)
+        .astype(np.uint32))
+    nbr = jnp.asarray(rng.integers(0, B, size=(B, 8), dtype=np.int32))
+    mask = jnp.ones((B,), jnp.uint32).at[0].set(0)  # slot 0 stays dead
+    return BuiltRunner(
+        lowerable=make_multi_step_paged(CONWAY, tr, tw, donate=True),
+        example_args=(tiles, 8, nbr, mask), donated_argnums=(0,),
+        require_gather=True)

@@ -97,19 +97,34 @@ class AdmissionController:
         in_use = sum(s.get("value", 0.0) for s in use_series)
         return in_use, limit
 
-    def decide(self, cost_bytes: int, *, tenant: str = "?") -> str:
+    def decide(self, cost_bytes: int, *, tenant: str = "?",
+               pool_needed: int = 0,
+               pool_free: Optional[int] = None) -> str:
         """One verdict for a create whose modelled lane cost is
-        ``cost_bytes``; records the decision counter."""
+        ``cost_bytes``; records the decision counter.
+
+        ``pool_needed``/``pool_free`` add the tile-pool budget (paged
+        lanes price in physical tiles, serve/lanes.PagedLanePool
+        .pool_pressure): a create whose seed needs more tiles than the
+        pool has free queues or rejects exactly like an HBM overdraft —
+        pool exhaustion is a scheduling verdict here, never a raise on
+        the placement path."""
         verdict = ADMIT
         usage = self.hbm_usage()
         if usage is not None:
             in_use, limit = usage
             if in_use + cost_bytes > self.headroom_fraction * limit:
-                with self._lock:
-                    depth = len(self._queue)
-                verdict = QUEUE if depth < self.queue_limit else REJECT
+                verdict = self._queue_or_reject()
+        if verdict == ADMIT and pool_free is not None \
+                and pool_needed > pool_free:
+            verdict = self._queue_or_reject()
         self._decisions.inc(decision=verdict, tenant=tenant)
         return verdict
+
+    def _queue_or_reject(self) -> str:
+        with self._lock:
+            depth = len(self._queue)
+        return QUEUE if depth < self.queue_limit else REJECT
 
     # -- the queue -----------------------------------------------------------
 
@@ -125,11 +140,14 @@ class AdmissionController:
         with self._lock:
             return len(self._queue)
 
-    def drain(self, cost_fn, now: float):
+    def drain(self, cost_fn, now: float, fit_fn=None):
         """Pop every queued create that fits the *current* budget (FIFO —
         a big head request blocks smaller ones behind it; fairness over
-        utilization). ``cost_fn(item) -> bytes``. Yields items and
-        observes their queue wait."""
+        utilization). ``cost_fn(item) -> bytes``; optional
+        ``fit_fn(item) -> bool`` adds a non-byte budget (tile-pool
+        pressure) — a head that does not fit stays at the head, keeping
+        its place for the next drain. Yields items and observes their
+        queue wait."""
         out = []
         while True:
             with self._lock:
@@ -141,6 +159,8 @@ class AdmissionController:
                 in_use, limit = usage
                 if in_use + cost_fn(item) > self.headroom_fraction * limit:
                     break
+            if fit_fn is not None and not fit_fn(item):
+                break
             with self._lock:
                 # re-check the head: a concurrent drain may have won
                 if not self._queue or self._queue[0][0] is not item:

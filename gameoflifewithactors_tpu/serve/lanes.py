@@ -118,9 +118,14 @@ class SpecFamily:
 
 # -- the runner cache ---------------------------------------------------------
 #
-# One masked runner per (rule, topology, backend): tracked_jit caches
-# compiled executables per batch shape inside it, so every lane of a
-# family — and every test in the process — shares warm executables.
+# One masked runner per (rule, topology, backend) — and one paged runner
+# per (rule, tile geometry): tracked_jit caches compiled executables per
+# batch shape inside it, so every lane of a family — and every test in
+# the process — shares warm executables. Every cache key MUST carry every
+# trace-constant baked into the program: the paged runner's key includes
+# the slab geometry precisely because a test that resizes the pool's
+# tile shape would otherwise be handed a stale executable traced for the
+# old one (pool *capacity* is a runtime shape axis and needs no key).
 
 _RUNNERS: Dict[tuple, object] = {}
 _MESH = None
@@ -154,6 +159,21 @@ def lane_runner(family: SpecFamily):
             else:
                 runner = batched.make_multi_step_packed_batched(
                     mesh, family.rule, family.topology, masked=True)
+            _RUNNERS[key] = runner
+        return runner
+
+
+def paged_lane_runner(rule, tile_rows: int, tile_words: int):
+    """The paged pool runner for a rule at a slab geometry
+    (get-or-create). Keyed on (rule, tile_rows, tile_words): the
+    geometry is a trace constant of the program, so two pools of
+    different tile shapes must never alias one cache entry."""
+    key = ("paged", rule.notation, int(tile_rows), int(tile_words))
+    with _RUNNER_LOCK:
+        runner = _RUNNERS.get(key)
+        if runner is None:
+            runner = batched.make_multi_step_paged(
+                rule, int(tile_rows), int(tile_words))
             _RUNNERS[key] = runner
         return runner
 
@@ -373,3 +393,242 @@ class LanePool:
 
     def stats(self) -> List[dict]:
         return [lane.stats() for lane in self.lanes.values()]
+
+    # -- admission pricing ----------------------------------------------------
+
+    def admission_cost(self, words=None) -> int:
+        """Modelled bytes one create claims (the ladder model: a full
+        dense slot, whatever the seed looks like)."""
+        return self.family.slot_bytes()
+
+    def pool_pressure(self, words=None):
+        """(tiles needed, tiles free) for pool-backed placement; None
+        for the ladder, which has no fixed physical budget to starve."""
+        return None
+
+    def bytes_held(self) -> int:
+        """Modelled HBM bytes this family's lanes hold."""
+        return self.total_capacity() * self.family.slot_bytes()
+
+
+# -- paged lanes: the ladder, collapsed ---------------------------------------
+#
+# A PagedLanePool keeps the LanePool surface the service drives (place /
+# release / compact / warm / lanes) but drops the capacity ladder
+# entirely: sessions become page-table grids over ONE shared
+# memory.TilePool, admission is priced in *tiles the seed actually
+# occupies* instead of worst-case dense slots, and every family of the
+# same rule — whatever its logical geometry — dispatches through the one
+# warm paged executable. Growth and compaction stop being events (the
+# free list is always compact); pool pressure replaces them as the
+# scheduling signal (serve/admission.py queues on it, step_grids stalls
+# on it).
+
+
+class PagedLane:
+    """One dispatch surface of page-table grids — the paged duck-type of
+    :class:`Lane`. Slots grow on demand (occupancy is a runtime mask and
+    per-grid page tables, so there is no batch shape to ladder);
+    :meth:`step` returns per-slot generations completed, short of ``n``
+    only for slots the tile pool could not provision mid-flight."""
+
+    def __init__(self, lane_id: str, family: SpecFamily, tile_pool,
+                 chunk_gens: Optional[int] = None):
+        from ..memory import PagedGrid  # noqa: F401 — validated below
+
+        trc, _ = tile_pool.tile_cells()
+        if family.height % trc or family.wq % tile_pool.tile_words:
+            raise ValueError(
+                f"family {family.key} ({family.height} x {family.wq} words) "
+                f"does not divide into the pool's {trc}-row x "
+                f"{tile_pool.tile_words}-word tiles")
+        self.lane_id = lane_id
+        self.family = family
+        self.pool = tile_pool
+        self.bounds = (family.height // trc,
+                       family.wq // tile_pool.tile_words)
+        self.chunk_gens = chunk_gens
+        self.slots: List[Optional[str]] = []
+        self.grids: List[Optional[object]] = []
+        self.steps_dispatched = 0
+        self.fail_next = False  # same injected-crash seam as Lane
+
+    @property
+    def capacity(self) -> int:
+        return len(self.slots)
+
+    def live_count(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def place(self, sid: str, words: np.ndarray) -> int:
+        from ..memory import PagedGrid
+
+        grid = PagedGrid(self.pool, topology=self.family.topology,
+                         bounds=self.bounds)
+        try:
+            grid.seed_words(np.asarray(words, np.uint32)[None])
+        except Exception:
+            grid.drop()  # release any pages bound before exhaustion
+            raise
+        slot = self.free_slot()
+        if slot is None:
+            self.slots.append(sid)
+            self.grids.append(grid)
+            return len(self.slots) - 1
+        self.slots[slot] = sid
+        self.grids[slot] = grid
+        return slot
+
+    def release(self, slot: int) -> None:
+        grid = self.grids[slot]
+        if grid is not None:
+            grid.drop()
+        self.slots[slot] = None
+        self.grids[slot] = None
+
+    def read(self, slot: int) -> np.ndarray:
+        return self.grids[slot].to_words()[0]
+
+    def write(self, slot: int, words: np.ndarray) -> None:
+        grid = self.grids[slot]
+        grid.drop()
+        grid.seed_words(np.asarray(words, np.uint32)[None])
+
+    def occupancy_mask(self, live_sids=None) -> np.ndarray:
+        mask = np.zeros((self.capacity,), dtype=np.uint32)
+        for i, sid in enumerate(self.slots):
+            if sid is not None and (live_sids is None or sid in live_sids):
+                mask[i] = 1
+        return mask
+
+    def step(self, n: int, mask: np.ndarray) -> np.ndarray:
+        """Advance masked slots ``n`` generations; returns (capacity,)
+        int64 generations completed per slot (pool exhaustion stalls a
+        slot partway; co-tenants still finish)."""
+        from ..memory import step_grids
+
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError(f"injected lane fault ({self.lane_id})")
+        done = np.zeros((self.capacity,), np.int64)
+        idx = [i for i, sid in enumerate(self.slots)
+               if sid is not None and i < len(mask) and mask[i]]
+        with obs_spans.span("engine.step", generations=int(n),
+                            lane=self.lane_id, capacity=self.capacity):
+            if idx:
+                out = step_grids(self.pool, [self.grids[i] for i in idx],
+                                 int(n), self.chunk_gens)
+                for j, i in enumerate(idx):
+                    done[i] = out[j]
+        self.steps_dispatched += int(n)
+        return done
+
+    def stats(self) -> dict:
+        return {"lane": self.lane_id, "family": self.family.key,
+                "capacity": self.capacity, "live": self.live_count(),
+                "paged": True, "tiles": sum(
+                    len(g.pages) for g in self.grids if g is not None)}
+
+
+# a nominal average session footprint (tiles) for mapping legacy ladder
+# configs onto pool capacity — see pool_capacity_for_ladder
+TILES_PER_SLOT = 8
+
+
+def pool_capacity_for_ladder(ladder: Tuple[int, ...] = LANE_LADDER,
+                             tiles_per_slot: int = TILES_PER_SLOT) -> int:
+    """Map an old lane-ladder config onto tile-pool capacity, so configs
+    written for the ladder keep working after the collapse: the ladder's
+    nominal fleet (8 top-rung lanes) times a nominal per-session
+    footprint of ``tiles_per_slot`` tiles, plus the reserved dead slot.
+    Explicit ``paged_opts['capacity']`` overrides this entirely."""
+    top = max(int(c) for c in ladder)
+    return 1 + 8 * int(tiles_per_slot) * top
+
+
+class PagedLanePool:
+    """All paged sessions of one family over the shared tile pool — the
+    :class:`LanePool` duck-type with the ladder collapsed to a single
+    elastic lane. ``compact``/``repack`` are no-ops (a free-list pool is
+    always compact; nothing ever moves), and ``warm`` warms the ONE
+    executable every geometry of this rule shares."""
+
+    def __init__(self, family: SpecFamily,
+                 ladder: Tuple[int, ...] = LANE_LADDER, *,
+                 tile_pool, chunk_gens: Optional[int] = None):
+        self.family = family
+        self.ladder = tuple(sorted(set(int(c) for c in ladder)))
+        self.tile_pool = tile_pool
+        self.chunk_gens = chunk_gens
+        self.lanes: Dict[str, PagedLane] = {}
+        self.compactions = 0
+        self.warmed = False
+
+    def _lane(self) -> PagedLane:
+        if not self.lanes:
+            lane = PagedLane(f"{self.family.key}#paged", self.family,
+                             self.tile_pool, self.chunk_gens)
+            self.lanes[lane.lane_id] = lane
+        return next(iter(self.lanes.values()))
+
+    def plan(self, count: int) -> List[int]:
+        return [int(count)] if count else []
+
+    def total_capacity(self) -> int:
+        return sum(lane.capacity for lane in self.lanes.values())
+
+    def live_count(self) -> int:
+        return sum(lane.live_count() for lane in self.lanes.values())
+
+    def warm(self) -> None:
+        if not self.warmed:
+            self.tile_pool.warm()
+            self.warmed = True
+
+    def place(self, sid: str, words: np.ndarray) -> Tuple[str, int, dict]:
+        lane = self._lane()
+        slot = lane.place(sid, words)  # PoolExhausted propagates
+        return lane.lane_id, slot, {}
+
+    def release(self, lane_id: str, slot: int) -> None:
+        self.lanes[lane_id].release(slot)
+
+    def compact(self) -> dict:
+        return {}
+
+    def repack(self, target_count: int) -> dict:
+        return {}
+
+    def stats(self) -> List[dict]:
+        return [lane.stats() for lane in self.lanes.values()]
+
+    # -- admission pricing ----------------------------------------------------
+
+    def tiles_needed(self, words: Optional[np.ndarray]) -> int:
+        """Tiles a seed binds NOW: its nonzero tiles (the dead majority
+        stays aliased to the pool's dead slot; wake rings bind lazily at
+        the first step and retire behind the front)."""
+        if words is None:
+            return 0
+        trc, _ = self.tile_pool.tile_cells()
+        tw = self.tile_pool.tile_words
+        nty, ntx = self.family.height // trc, self.family.wq // tw
+        w = np.asarray(words).reshape(nty, trc, ntx, tw)
+        return int(w.any(axis=(1, 3)).sum())
+
+    def admission_cost(self, words=None) -> int:
+        return self.tiles_needed(words) * self.tile_pool.tile_bytes()
+
+    def pool_pressure(self, words=None):
+        return (self.tiles_needed(words), self.tile_pool.free_count())
+
+    def bytes_held(self) -> int:
+        tiles = sum(len(g.pages) for lane in self.lanes.values()
+                    for g in lane.grids if g is not None)
+        return tiles * self.tile_pool.tile_bytes()

@@ -37,7 +37,8 @@ from gameoflifewithactors_tpu.obs.aggregate import (  # noqa: E402
     AggregatorServer, FleetAggregator, base_name)
 
 COLUMNS = ("PROC", "UP", "LANES", "SLOTS", "SESS", "TENANTS", "STEPS/S",
-           "HBM", "HB-MISS", "RETRACE", "STALLS", "PROF", "PROF-OH")
+           "HBM", "POOL", "HB-MISS", "RETRACE", "STALLS", "PROF",
+           "PROF-OH")
 
 
 def _samples(parsed: Optional[dict], family: str) -> List[tuple]:
@@ -82,6 +83,12 @@ def row_for(proc: str, parsed: Optional[dict]) -> List[str]:
                   default=0.0)
     hbm = (f"{_fmt_bytes(hbm_use)}/{_fmt_bytes(hbm_lim)}"
            if hbm_lim else (_fmt_bytes(hbm_use) if hbm_use else "-"))
+    # paged tile pools (memory/pool.py): in-use/capacity summed over
+    # this proc's pools — same-chip sums, like the tenant gauges above
+    pool_used = _total(parsed, "pool_tiles_in_use")
+    pool_free = _total(parsed, "pool_tiles_free")
+    pool = (f"{pool_used:.0f}/{pool_used + pool_free:.0f}"
+            if (pool_used or pool_free) else "-")
     return [
         proc, "up",
         f"{_total(parsed, 'session_lanes'):.0f}",
@@ -90,6 +97,7 @@ def row_for(proc: str, parsed: Optional[dict]) -> List[str]:
         f"{len(tenants)}",
         f"{steps:.1f}",
         hbm,
+        pool,
         f"{_total(parsed, 'elastic_heartbeat_misses_total'):.0f}",
         f"{_total(parsed, 'jit_compiles'):.0f}",
         f"{_total(parsed, 'stalls'):.0f}",

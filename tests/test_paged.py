@@ -1,0 +1,351 @@
+"""Paged tile-pool grid memory (memory/, ROADMAP item 3).
+
+The claims under test:
+
+- **bit-identity** — a universe split across pool pages, with halos
+  resolved by page-table gather and missing pages aliasing the dead
+  tile, equals the dense NumPy oracle exactly: Conway, Larger-than-Life
+  and Generations, both topologies;
+- **unbounded flight** — a glider on a ``bounds=None`` plane crosses
+  page boundaries indefinitely while the pool footprint stays constant
+  (pages allocate at the wake front, retire behind);
+- **pool pressure** — exhaustion raises :class:`PoolExhausted` at the
+  allocator and stalls only the starved grid in the multi-tenant pump;
+  releases reclaim, and the gauges/counters track every transition;
+- **zero retraces** — after :meth:`TilePool.warm`, allocation churn,
+  page retirement and stepping never compile (``retrace_budget(0)``);
+- **sparse payoff** — a 4096² universe that is ~2% live binds < 10% of
+  the dense tile count;
+- **checkpoint** — ``save_paged``/``load_paged`` round-trips the sparse
+  page list bit-exactly, and the restored grid keeps flying identically.
+"""
+
+import numpy as np
+import pytest
+
+from gameoflifewithactors_tpu.analysis.sanitizers import retrace_budget
+from gameoflifewithactors_tpu.engine import Engine
+from gameoflifewithactors_tpu.memory import (
+    DEAD_SLOT,
+    PagedEngineState,
+    PagedGrid,
+    PagedUniverse,
+    PoolExhausted,
+    TilePool,
+    step_grids,
+)
+from gameoflifewithactors_tpu.models.generations import GenRule, parse_any
+from gameoflifewithactors_tpu.models.ltl import BOSCO, LtLRule
+from gameoflifewithactors_tpu.obs.registry import MetricsRegistry
+from gameoflifewithactors_tpu.ops import bitpack
+from gameoflifewithactors_tpu.ops.stencil import Topology
+from gameoflifewithactors_tpu.serve import lanes as serve_lanes
+
+from .oracle import numpy_run
+from .test_generations import oracle as generations_oracle
+from .test_ltl import oracle as ltl_oracle
+
+GLIDER = ((0, 1), (1, 2), (2, 0), (2, 1), (2, 2))  # flies down-right
+
+
+def glider_cells(h=8, w=8, at=(0, 0)):
+    cells = np.zeros((h, w), np.uint8)
+    for y, x in GLIDER:
+        cells[at[0] + y, at[1] + x] = 1
+    return cells
+
+
+def soup(rule, h, w, fill=0.35, seed=0):
+    rng = np.random.default_rng(seed)
+    states = getattr(parse_any(rule), "states", 2)
+    if states > 2:
+        return rng.integers(0, states, size=(h, w), dtype=np.uint8)
+    return (rng.random((h, w)) < fill).astype(np.uint8)
+
+
+def reference(grid, rule, topology, n):
+    """Per-family dense NumPy oracle (each family's own test module)."""
+    rule = parse_any(rule)
+    torus = topology is Topology.TORUS
+    if isinstance(rule, LtLRule):
+        return ltl_oracle(grid, rule, torus, n)
+    if isinstance(rule, GenRule):
+        return generations_oracle(grid, rule, torus, n)
+    return numpy_run(grid, rule, topology, n)
+
+
+def pack2d(cells):
+    """(H, W) binary cells -> (1, H, W/32) words for PagedGrid.seed_words."""
+    return np.asarray(bitpack.pack_np(np.asarray(cells, np.uint8)))[None]
+
+
+# -- oracle bit-identity through the Engine's paged backend -------------------
+
+
+@pytest.mark.parametrize("topology", [Topology.TORUS, Topology.DEAD])
+@pytest.mark.parametrize("rule,shape,opts", [
+    ("B3/S23", (64, 64), {"tile_rows": 16, "tile_words": 1}),
+    ("B3/S23", (64, 128), {"tile_rows": 32, "tile_words": 2}),
+    (BOSCO.notation, (64, 64), {"tile_rows": 16, "tile_words": 2}),
+    ("B2/S/C3", (64, 64), {"tile_rows": 16, "tile_words": 1}),
+])
+def test_paged_engine_matches_oracle(rule, shape, opts, topology):
+    grid = soup(rule, *shape, seed=7)
+    eng = Engine(grid, rule, topology=topology, backend="paged",
+                 sparse_opts=opts)
+    eng.step(13)
+    want = reference(grid, rule, topology, 13)
+    assert np.array_equal(eng.snapshot(), want)
+    assert eng.backend == "paged"
+    if want.any():
+        assert eng.active_tiles() > 0
+    else:
+        # an extinct universe retires every page (BOSCO soups at this
+        # density die out) — extinction costs zero tiles
+        assert eng.active_tiles() == 0
+
+
+def test_paged_backend_rejects_mesh_and_b0():
+    from gameoflifewithactors_tpu.parallel import mesh as mesh_lib
+
+    with pytest.raises(ValueError, match="single-device"):
+        Engine(np.zeros((64, 64), np.uint8), "B3/S23",
+               mesh=mesh_lib.make_mesh(), backend="paged")
+    # birth-from-nothing breaks "missing page = dead tile" closure
+    with pytest.raises(ValueError, match="birth"):
+        Engine(np.zeros((64, 64), np.uint8), "B0/S8", backend="paged")
+
+
+def test_paged_engine_set_grid_reseeds_through_pool():
+    grid = soup("B3/S23", 64, 64, seed=11)
+    eng = Engine(grid, "B3/S23", backend="paged",
+                 sparse_opts={"tile_rows": 16, "tile_words": 1})
+    eng.step(9)
+    eng.set_grid(grid, 0)
+    eng.step(9)
+    assert np.array_equal(eng.snapshot(),
+                          reference(grid, "B3/S23", Topology.TORUS, 9))
+
+
+# -- unbounded flight ---------------------------------------------------------
+
+
+def test_glider_crosses_page_boundaries_with_constant_footprint():
+    """A glider on the unbounded plane crosses >= 3 page boundaries
+    (tile rows are 16 cells; 256 generations move it 64 cells) while the
+    pool footprint stays a constant handful of tiles and the trail
+    retires back to the free list."""
+    reg = MetricsRegistry()
+    pool = TilePool("B3/S23", 64, tile_rows=16, tile_words=1,
+                    name="flight", registry=reg)
+    u = PagedUniverse(pool.rule, pool=pool)
+    u.seed_cells(glider_cells(), origin=(1, 1))
+    u.pool.warm()
+    row_bands = set()
+    for _ in range(16):
+        u.step(16)
+        assert u.population() == 5
+        (ty0, _tx0), _ = u.grid.live_tile_bbox()
+        row_bands.add(ty0)
+        # constant footprint: live page + one wake ring, never the trail
+        assert pool.in_use() <= 12
+    assert len(row_bands) >= 4  # >= 3 tile-row boundary crossings
+    (ty0, _tx0), _ = u.grid.live_tile_bbox()
+    assert ty0 >= 4, "glider never left its seed pages"
+    # retirement actually reclaimed the trail
+    assert reg.counter("pool_reclaim_total").value(pool="flight") > 0
+
+
+def test_unbounded_matches_bounded_oracle_mid_flight():
+    """The unbounded plane's glider, windowed out, equals the dense DEAD
+    oracle of a grid big enough to contain the flight."""
+    side = 96
+    cells = np.zeros((side, side), np.uint8)
+    cells[1:9, 1:9] = glider_cells()
+    u = PagedUniverse("B3/S23", capacity=128, tile_rows=16, tile_words=1)
+    u.seed_cells(cells[:16, :32], origin=(0, 0))
+    u.step(200)
+    want = reference(cells, "B3/S23", Topology.DEAD, 200)
+    origin, got = u.snapshot_cells()
+    dense = np.zeros((side, side), np.uint8)
+    dense[origin[0]:origin[0] + got.shape[0],
+          origin[1]:origin[1] + got.shape[1]] = got
+    assert np.array_equal(dense, want)
+
+
+# -- pool pressure, eviction and reclaim --------------------------------------
+
+
+def test_pool_exhaustion_raises_and_counts():
+    reg = MetricsRegistry()
+    pool = TilePool("B3/S23", 4, tile_rows=16, tile_words=1,
+                    name="tiny", registry=reg)
+    slots = [pool.alloc() for _ in range(3)]
+    assert DEAD_SLOT not in slots
+    assert pool.free_count() == 0 and pool.in_use() == 3
+    with pytest.raises(PoolExhausted):
+        pool.alloc()
+    assert reg.counter("pool_oom_total").value(pool="tiny") == 1
+    assert reg.gauge("pool_tiles_free").value(pool="tiny") == 0
+    pool.release(slots[0])
+    assert pool.free_count() == 1
+    assert pool.alloc() == slots[0]  # reclaimed slot comes back
+    with pytest.raises(ValueError):
+        pool.release(DEAD_SLOT)
+
+
+def test_pool_pressure_stalls_only_the_starved_grid():
+    """Two grids on one small pool: when one cannot provision its wake
+    ring, step_grids stalls IT for the rest of the call and keeps
+    stepping the co-tenant; releasing pressure un-stalls it."""
+    pool = TilePool("B3/S23", 12, tile_rows=16, tile_words=1)
+    a = PagedGrid(pool, topology=Topology.TORUS, bounds=(2, 1))
+    b = PagedGrid(pool, topology=Topology.DEAD, bounds=None)
+    a.seed_words(pack2d(soup("B3/S23", 32, 32, seed=3)))
+    b.seed_words(pack2d(glider_cells(16, 32, at=(6, 14))))
+    # burn the free list so b's wake ring cannot bind
+    hoard = [pool.alloc() for _ in range(pool.free_count())]
+    done = step_grids(pool, [a, b], 8)
+    assert done[0] == 8, "torus grid (no new pages needed) must not stall"
+    assert done[1] < 8, "unbounded grid must stall on the empty pool"
+    for s in hoard:
+        pool.release(s)
+    done = step_grids(pool, [b], 8)  # pressure released: b catches up
+    assert done[0] == 8
+
+
+def test_release_restores_free_slots_are_zero_invariant():
+    pool = TilePool("B3/S23", 4, tile_rows=16, tile_words=1)
+    slot = pool.alloc()
+    pool.write(slot, np.full((1, 16, 1), 0xFFFFFFFF, np.uint32))
+    pool.release(slot)
+    assert not pool.tiles_host()[slot].any()
+    assert (pool.neighbors[slot] == DEAD_SLOT).all()
+
+
+# -- zero retraces across allocation churn ------------------------------------
+
+
+def test_retrace_budget_zero_across_allocation_churn():
+    """After warm, a page-crossing glider (allocating at the front,
+    retiring behind, every chunk) never compiles — and neither does a
+    full drop + reseed (release/alloc/write churn)."""
+    pool = TilePool("B3/S23", 64, tile_rows=16, tile_words=1)
+    u = PagedUniverse(pool.rule, pool=pool)
+    u.seed_cells(glider_cells(), origin=(1, 1))
+    pool.warm()
+    with retrace_budget(0, context="paged allocation churn"):
+        for _ in range(24):
+            u.step(16)
+        u.grid.drop()
+        u.seed_cells(glider_cells(), origin=(5, 5))
+        u.step(64)
+    assert u.population() == 5
+
+
+# -- the sparse payoff --------------------------------------------------------
+
+
+def test_4096_mostly_empty_universe_allocates_under_10pct_of_dense():
+    """ISSUE 20 acceptance: a 4096 x 4096 logical universe <= 2% live
+    (one clustered soup) binds < 10% of the dense tile count, and steps
+    bit-identically to the packed dense engine."""
+    side = 4096
+    grid = np.zeros((side, side), np.uint8)
+    grid[1792:2304, 1792:2304] = soup("B3/S23", 512, 512, seed=5)
+    live_frac = grid.sum() / grid.size
+    assert live_frac <= 0.02
+    eng = Engine(grid, "B3/S23", topology=Topology.DEAD, backend="paged",
+                 sparse_opts={"tile_rows": 32, "tile_words": 4})
+    dense_tiles = (side // 32) * ((side // 32) // 4)
+    assert dense_tiles == 4096
+    eng.step(3)
+    assert eng.active_tiles() < dense_tiles // 10, \
+        f"{eng.active_tiles()} tiles bound for a {live_frac:.1%}-live grid"
+    ref = Engine(grid, "B3/S23", topology=Topology.DEAD, backend="packed")
+    ref.step(3)
+    assert np.array_equal(eng.snapshot(), ref.snapshot())
+
+
+# -- checkpoint/resume --------------------------------------------------------
+
+
+def test_save_load_paged_round_trip_bit_identical(tmp_path):
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    u = PagedUniverse("B3/S23", capacity=64, tile_rows=16, tile_words=1)
+    u.seed_cells(glider_cells(), origin=(1, 1))
+    u.step(100)
+    path = ckpt.save_paged(u, tmp_path / "glider.npz")
+    grid2, meta = ckpt.load_paged(path)
+    assert meta["generation"] == 100
+    twin = PagedUniverse(grid2.pool.rule, pool=grid2.pool)
+    twin.grid = grid2
+    u.step(100)
+    twin.step(100)
+    assert u.generation == twin.generation == 200
+    o1, c1 = u.snapshot_cells()
+    o2, c2 = twin.snapshot_cells()
+    assert o1 == o2 and np.array_equal(c1, c2)
+
+
+def test_load_paged_refuses_garbage_and_mismatched_pool(tmp_path):
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    u = PagedUniverse("B3/S23", capacity=16, tile_rows=16, tile_words=1)
+    u.seed_cells(glider_cells())
+    path = ckpt.save_paged(u, tmp_path / "u.npz")
+    with pytest.raises(ValueError, match="does not match"):
+        ckpt.load_paged(path, pool=TilePool("B3/S23", 16, tile_rows=32,
+                                            tile_words=1))
+    bad = tmp_path / "bad.npz"
+    bad.write_bytes(b"not an npz")
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_paged(bad)
+
+
+def test_paged_engine_checkpoints_through_engine_save(tmp_path):
+    """The bounded paged engine rides the ordinary packed32 checkpoint
+    (save reads .state, which reconstructs dense words)."""
+    from gameoflifewithactors_tpu.utils import checkpoint as ckpt
+
+    grid = soup("B3/S23", 64, 64, seed=2)
+    eng = Engine(grid, "B3/S23", backend="paged",
+                 sparse_opts={"tile_rows": 16, "tile_words": 1})
+    eng.step(7)
+    path = ckpt.save(eng, tmp_path / "e.npz")
+    eng2 = ckpt.load_engine(path, backend="paged")
+    eng.step(7)
+    eng2.step(7)
+    assert np.array_equal(eng.snapshot(), eng2.snapshot())
+    assert eng2.generation == 14
+
+
+# -- runner-cache geometry keys (regression) ----------------------------------
+
+
+def test_lane_runner_cache_keys_include_pool_geometry():
+    """Regression: a resized pool slab must NOT alias the executable
+    compiled for the old geometry — the module-level runner cache keys
+    carry (rule, tile_rows, tile_words)."""
+    rule = parse_any("B3/S23")
+    r16 = serve_lanes.paged_lane_runner(rule, 16, 1)
+    r32 = serve_lanes.paged_lane_runner(rule, 32, 1)
+    r16w = serve_lanes.paged_lane_runner(rule, 16, 2)
+    assert r16 is not r32 and r16 is not r16w and r32 is not r16w
+    assert serve_lanes.paged_lane_runner(rule, 16, 1) is r16  # cache hit
+    assert serve_lanes.paged_lane_runner(BOSCO, 16, 1) is not r16
+    # and both geometries actually run through their keyed runners
+    for tr, runner in ((16, r16), (32, r32)):
+        pool = TilePool(rule, 4, tile_rows=tr, tile_words=1, runner=runner)
+        g = PagedGrid(pool, topology=Topology.TORUS, bounds=(1, 1))
+        g.seed_words(pack2d(soup("B3/S23", tr, 32, seed=1)))
+        assert step_grids(pool, [g], 4)[0] == 4
+
+
+def test_pool_capacity_for_ladder_maps_old_configs():
+    """MIGRATING contract: the ladder-collapse mapping sizes the pool
+    from the old ladder's top rung."""
+    cap = serve_lanes.pool_capacity_for_ladder((1, 8, 64, 256))
+    assert cap == 1 + 8 * serve_lanes.TILES_PER_SLOT * 256
+    assert serve_lanes.pool_capacity_for_ladder((1,)) > 1

@@ -391,6 +391,52 @@ def test_e2e_thousand_sessions_few_lanes():
     assert 'goltpu_sessions_live{tenant="tenant0"} 250' in text
 
 
+def test_e2e_thousand_mixed_geometry_sessions_one_pool():
+    """ISSUE-20 acceptance: 1000 sessions of MIXED logical geometry
+    (32x32 torus, 64x32 torus, 16x32 dead — one rule) pack onto ONE
+    tile pool and step through a single warm executable with zero
+    post-warm retraces, every one bit-identical to its oracle, with the
+    pool gauges on the exposition."""
+    paged_families = (
+        {"rule": "B3/S23", "height": 32, "width": 32, "topology": "torus"},
+        {"rule": "B3/S23", "height": 64, "width": 32, "topology": "torus"},
+        {"rule": "B3/S23", "height": 16, "width": 32, "topology": "dead"},
+    )
+    svc, reg = make_service(
+        ladder=(1, 8, 64, 256), paged=True,
+        paged_opts={"tile_rows": 16, "tile_words": 1, "capacity": 3000})
+    for f in paged_families:
+        svc.warm(f)
+    # mixed geometries AND topologies share one pool -> one executable
+    assert len(svc._tile_pools) == 1
+    N = 1000
+    sids, gens = [], []
+    with retrace_budget(0, context="paged serve e2e"):
+        for i in range(N):
+            sids.append(svc.create(f"tenant{i % 4}", paged_families[i % 3],
+                                   fill=FILL, rng_seed=i)["sid"])
+        for i, sid in enumerate(sids):
+            n = 1 + i % 4
+            svc.step(sid, n, pump=False)
+            gens.append(n)
+        svc.pump()
+    assert len(svc._tile_pools) == 1
+    assert svc.counts()["sessions"]["live"] == N
+    for i, sid in enumerate(sids):
+        assert np.array_equal(
+            svc.grid(sid), expected_grid(paged_families[i % 3], i, gens[i])), \
+            f"session {i} diverged from its oracle"
+    text = render_prometheus(reg.snapshot())
+    assert 'goltpu_pool_tiles_in_use{pool="serve:B3/S23"}' in text
+    assert 'goltpu_pool_tiles_free{pool="serve:B3/S23"}' in text
+    pool = next(iter(svc._tile_pools.values()))
+    assert pool.in_use() > 0
+    # closes hand every page back to the free list
+    for sid in sids:
+        svc.close(sid)
+    assert pool.in_use() == 0
+
+
 # -- the HTTP frontend --------------------------------------------------------
 
 
